@@ -1,0 +1,13 @@
+"""progen-tpu: a TPU-native protein language model framework.
+
+Capability parity with the reference ProGen implementation (JAX/Haiku,
+single GPU) re-designed TPU-first: one device mesh, sharding-rule
+parallelism (DP/FSDP/TP/SP), bf16 MXU compute, scan-based cached decoding,
+sharded checkpoints, and an SPMD tfrecord input pipeline.
+"""
+
+__version__ = "0.1.0"
+
+from progen_tpu.models.progen import ProGen, ProGenConfig
+
+__all__ = ["ProGen", "ProGenConfig", "__version__"]

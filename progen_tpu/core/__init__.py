@@ -1,0 +1,13 @@
+from progen_tpu.core.mesh import MESH_AXES, MeshConfig, make_mesh, single_device_mesh
+from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.core.rng import KeySeq
+
+__all__ = [
+    "MESH_AXES",
+    "MeshConfig",
+    "make_mesh",
+    "single_device_mesh",
+    "Policy",
+    "make_policy",
+    "KeySeq",
+]

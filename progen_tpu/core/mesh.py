@@ -1,0 +1,106 @@
+"""Device-mesh construction — the substrate for every parallelism strategy.
+
+The reference's only parallelism is a single-host ``pmap`` data-parallel flag
+(``/root/reference/progen_transformer/utils.py:69-91``); its README leaves
+"model parallelism with pjit" as an unchecked TODO
+(``/root/reference/README.md:104``).  Here, every strategy — DP, FSDP, TP and
+sequence/context parallelism — is a sharding rule over ONE logical mesh with
+four axes:
+
+    ('data', 'fsdp', 'tensor', 'seq')
+
+* ``data``    — pure data parallelism (batch split, params replicated)
+* ``fsdp``    — batch split AND params/optimizer-state sharded (ZeRO-3 style)
+* ``tensor``  — megatron-style tensor parallelism inside each matmul
+* ``seq``     — sequence/context parallelism (activations split along L,
+                halo exchange for the local-attention window structure)
+
+Axis sizes multiply to the device count; unused axes have size 1.  XLA lays
+consecutive mesh dims onto ICI neighbours, so the innermost (most
+communication-hungry) axes — ``tensor``/``seq`` — go last.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("data", "fsdp", "tensor", "seq")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each logical mesh axis. ``-1`` on one axis means "absorb the
+    remaining devices" (like a reshape wildcard)."""
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        sizes = [self.data, self.fsdp, self.tensor, self.seq]
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}"
+            )
+        return tuple(sizes)
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build the 4-axis mesh over the given (default: all) devices.
+
+    ``jax.experimental.mesh_utils.create_device_mesh`` picks an ICI-friendly
+    device order on real TPU slices; on CPU/virtual devices a plain reshape
+    is used.
+    """
+    config = config or MeshConfig()
+    devices = devices if devices is not None else jax.devices()
+    sizes = config.resolve(len(devices))
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    else:
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """A 1×1×1×1 mesh — lets every code path be mesh-driven, even one chip."""
+    device = device or jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape(1, 1, 1, 1), MESH_AXES)
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host runtime init (replaces: nothing — the reference is
+    single-process only, ``utils.py:80`` uses ``jax.local_device_count``).
+
+    On TPU pods with default env vars, ``jax.distributed.initialize()`` with
+    no arguments autodetects everything.  Safe to call exactly once per
+    process before any other JAX call.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,42 @@
+"""Deterministic threaded RNG.
+
+The reference monkeypatches ``jax.random.uniform``/``bernoulli`` with a
+key-ignoring ``lax.rng_uniform`` for GPU speed
+(``/root/reference/progen_transformer/utils.py:139-158``) and draws keys from
+a stateful ``haiku.PRNGSequence``.  Neither survives on TPU-first design:
+the monkeypatch breaks reproducibility and SPMD determinism, and stateful key
+sequences don't jit.  This module is the conscious replacement — pure
+``jax.random`` key threading with small helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+
+
+class KeySeq:
+    """Host-side key sequence for driver loops (not for use inside jit).
+
+    Drop-in for the reference's ``haiku.PRNGSequence(seed)`` usage at
+    ``/root/reference/train.py:112`` / ``sample.py:50``.
+    """
+
+    def __init__(self, seed: int | jax.Array):
+        if isinstance(seed, int):
+            self._key = jax.random.key(seed)
+        else:
+            self._key = seed
+
+    def __next__(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def __iter__(self) -> Iterator[jax.Array]:
+        return self
+
+    def take(self, n: int):
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return keys[1:]

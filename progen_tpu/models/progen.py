@@ -1,0 +1,307 @@
+"""ProGen model core — flax linen, natively batched, sharding-annotated.
+
+Behavior parity with the reference Haiku model
+(``/root/reference/progen_transformer/progen.py``), re-designed TPU-first:
+
+* natively batched ``(B, L) -> (B, L, num_tokens)`` (the reference is
+  unbatched ``(L,)`` and relies on an outer ``vmap``, ``progen.py:224-233``;
+  we keep its logits semantics, drop the shape contract);
+* explicit precision policy (bf16 MXU compute / f32 params+output) instead
+  of a class-wide jmp monkeypatch (``progen.py:235-241``);
+* every parameter and key activation carries a LOGICAL axis name
+  (t5x/maxtext convention) so DP/FSDP/TP/SP are pure rule tables over one
+  mesh — see ``progen_tpu/parallel/sharding.py``;
+* rotary tables are computed once per forward and shared by all layers
+  (same as reference ``progen.py:227``).
+
+Numerics contract implemented here (SURVEY.md §2.a):
+scale-only LayerNorm (eps 1e-5, Haiku default); rotary on q, k AND v;
+token-shift at the top of both blocks; windowed attention with
+previous-window visibility; GEGLU feed-forward; the LAST
+``global_mlp_depth`` layers swap GLU for the SGU/gMLP spatial gate; bare
+residual adds; LN+Linear head, no weight tying.
+
+The reference accepts dead kwargs ``clamp_gate``/``attn_dim``
+(``progen.py:201-202`` — never used); ``ProGenConfig.from_dict`` accepts and
+drops them for TOML/checkpoint config compatibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.ops.local_attention import local_attention
+from progen_tpu.ops.rotary import apply_rotary_pos_emb, fixed_pos_embedding
+from progen_tpu.ops.sgu import spatial_gate
+from progen_tpu.ops.shift import shift_tokens
+
+# kwargs the reference accepts but never reads (progen.py:201-202) plus
+# driver-level kwargs that are not model architecture.
+_IGNORED_CONFIG_KEYS = ("clamp_gate", "attn_dim", "mixed_precision")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProGenConfig:
+    num_tokens: int = 256
+    dim: int = 512
+    seq_len: int = 1024
+    depth: int = 12
+    window_size: int = 256
+    global_mlp_depth: int = 2
+    heads: int = 8
+    dim_head: int = 64
+    ff_mult: int = 4
+    ff_glu: bool = True
+    shift_tokens: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ProGenConfig":
+        clean = {k: v for k, v in d.items() if k not in _IGNORED_CONFIG_KEYS}
+        return cls(**clean)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def layer_uses_gmlp(self, i: int) -> bool:
+        """Layer i (0-based) uses the SGU/gMLP feed-forward iff it is among
+        the last ``global_mlp_depth`` layers (reference ``progen.py:211``)."""
+        return (self.depth - i) <= self.global_mlp_depth
+
+
+def _norm(policy: Policy, name: str | None = None) -> nn.LayerNorm:
+    # Scale-only LayerNorm, eps matching Haiku's default (reference
+    # ``progen.py:22``: create_scale=True, create_offset=False).
+    return nn.LayerNorm(
+        use_scale=True,
+        use_bias=False,
+        epsilon=1e-5,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+        name=name,
+    )
+
+
+def _dense(features: int, *, use_bias: bool, axes: tuple[str, str],
+           policy: Policy, name: str | None = None) -> nn.Dense:
+    bias_axes = (axes[-1],)
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), axes
+        ),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros, bias_axes),
+        name=name,
+    )
+
+
+class LocalAttention(nn.Module):
+    """Pre-LN windowed attention block (reference ``progen.py:50-103``).
+
+    QKV fused into one bias-free projection (reference ``progen.py:70``),
+    output projection with bias (``progen.py:71``).
+    """
+
+    dim: int
+    window_size: int
+    heads: int
+    dim_head: int
+    shift: bool
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x, sin, cos):
+        b, n, _ = x.shape
+        h, d = self.heads, self.dim_head
+        inner = h * d
+
+        x = _norm(self.policy, name="norm")(x)
+        if self.shift:
+            x = shift_tokens(x)
+
+        qkv = _dense(inner * 3, use_bias=False, axes=("embed", "qkv"),
+                     policy=self.policy, name="to_qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (B, L, H*D) -> (B, H, L, D)
+        q, k, v = (
+            t.reshape(b, n, h, d).transpose(0, 2, 1, 3) for t in (q, k, v)
+        )
+        # rotary on q, k AND v — reference progen.py:87
+        q, k, v = (apply_rotary_pos_emb(t, sin, cos) for t in (q, k, v))
+        q = nn.with_logical_constraint(q, ("act_batch", "act_heads", "act_seq", None))
+        k = nn.with_logical_constraint(k, ("act_batch", "act_heads", "act_seq", None))
+        v = nn.with_logical_constraint(v, ("act_batch", "act_heads", "act_seq", None))
+
+        out = local_attention(q, k, v, window_size=self.window_size,
+                              scale=d ** -0.5)
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, inner)
+        return _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
+                      policy=self.policy, name="to_out")(out)
+
+
+class SGU(nn.Module):
+    """gMLP spatial gating unit (reference ``progen.py:151-185``).
+
+    Learned causal ``(n, n)`` token-mixing weights init U(±eps/n) with
+    eps=1e-3, biases init to ones; gate half LayerNormed; output projected
+    to ``dim_out = hidden // 2``.
+    """
+
+    seq_len: int
+    dim_out: int
+    policy: Policy
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x):
+        n = self.seq_len
+        x, gate = jnp.split(x, 2, axis=-1)
+        gate = _norm(self.policy, name="norm")(gate)
+
+        init_scale = self.eps / n
+
+        def symmetric_uniform(key, shape, dtype):
+            return jax.random.uniform(
+                key, shape, dtype, minval=-init_scale, maxval=init_scale
+            )
+
+        weights = self.param(
+            "spatial_weights",
+            nn.with_logical_partitioning(
+                symmetric_uniform, ("spatial_row", "spatial_col")
+            ),
+            (n, n),
+            self.policy.param_dtype,
+        )
+        biases = self.param(
+            "spatial_biases",
+            nn.with_logical_partitioning(nn.initializers.ones, ("spatial_row", None)),
+            (n, 1),
+            self.policy.param_dtype,
+        )
+
+        gate = spatial_gate(gate, weights.astype(self.policy.compute_dtype),
+                            biases.astype(self.policy.compute_dtype))
+        x = x * gate
+        return _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
+                      policy=self.policy, name="proj_out")(x)
+
+
+class FeedForward(nn.Module):
+    """Pre-LN MLP with GEGLU or SGU variant (reference ``progen.py:105-149``).
+
+    ``glu`` and ``spatial_gate`` are mutually exclusive (``progen.py:118``);
+    the hidden dim doubles under GLU so the gated half matches ``dim*ff_mult``.
+    """
+
+    dim: int
+    seq_len: int
+    ff_mult: int
+    glu: bool
+    use_sgu: bool
+    shift: bool
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x):
+        assert not (self.glu and self.use_sgu)
+        hidden = self.dim * self.ff_mult * (2 if self.glu else 1)
+
+        x = _norm(self.policy, name="norm")(x)
+        if self.shift:
+            x = shift_tokens(x)
+
+        x = _dense(hidden, use_bias=True, axes=("embed", "mlp"),
+                   policy=self.policy, name="proj_in")(x)
+        x = nn.with_logical_constraint(x, ("act_batch", "act_seq", "act_mlp"))
+
+        if self.glu:
+            x, gate = jnp.split(x, 2, axis=-1)
+            x = x * nn.gelu(gate)
+        else:
+            x = nn.gelu(x)
+
+        if self.use_sgu:
+            x = SGU(seq_len=self.seq_len, dim_out=hidden // 2,
+                    policy=self.policy, name="sgu")(x)
+
+        return _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
+                      policy=self.policy, name="proj_out")(x)
+
+
+class ProGen(nn.Module):
+    """Full model: embed -> depth x [LocalAttention, FeedForward] -> head."""
+
+    config: ProGenConfig
+    policy: Policy = dataclasses.field(default_factory=make_policy)
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        if tokens.ndim != 2:
+            raise ValueError(
+                f"ProGen takes batched (B, L) int tokens, got shape {tokens.shape}; "
+                "the reference's unbatched (L,) contract was dropped — add a "
+                "leading batch dim"
+            )
+        b, n = tokens.shape
+        if cfg.global_mlp_depth > 0 and n != cfg.seq_len:
+            raise ValueError(
+                f"input length {n} != config.seq_len {cfg.seq_len}: the gMLP "
+                "layers' learned (seq_len, seq_len) spatial weights fix the "
+                "sequence length"
+            )
+
+        x = nn.Embed(
+            cfg.num_tokens,
+            cfg.dim,
+            dtype=self.policy.compute_dtype,
+            param_dtype=self.policy.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(1.0, "fan_in", "normal", out_axis=0),
+                ("vocab", "embed"),
+            ),
+            name="embed",
+        )(tokens)
+        x = nn.with_logical_constraint(x, ("act_batch", "act_seq", "act_embed"))
+
+        # rotary tables computed once, shared by all layers (progen.py:227);
+        # kept f32, cast inside apply.
+        sin, cos = fixed_pos_embedding(n, cfg.dim_head)
+
+        for i in range(cfg.depth):
+            use_gmlp = cfg.layer_uses_gmlp(i)
+            x = x + LocalAttention(
+                dim=cfg.dim,
+                window_size=cfg.window_size,
+                heads=cfg.heads,
+                dim_head=cfg.dim_head,
+                shift=cfg.shift_tokens,
+                policy=self.policy,
+                name=f"attn{i}",
+            )(x, sin, cos)
+            x = x + FeedForward(
+                dim=cfg.dim,
+                seq_len=cfg.seq_len,
+                ff_mult=cfg.ff_mult,
+                glu=(not use_gmlp) and cfg.ff_glu,
+                use_sgu=use_gmlp,
+                shift=cfg.shift_tokens,
+                policy=self.policy,
+                name=f"ff{i}",
+            )(x)
+            x = nn.with_logical_constraint(x, ("act_batch", "act_seq", "act_embed"))
+
+        x = _norm(self.policy, name="norm_out")(x)
+        logits = _dense(cfg.num_tokens, use_bias=True, axes=("embed", "vocab"),
+                        policy=self.policy, name="to_logits")(x)
+        return self.policy.cast_to_output(logits)

@@ -1,0 +1,21 @@
+from progen_tpu.ops.local_attention import (
+    ATTN_MASK_VALUE,
+    concat_previous_window,
+    local_attention,
+    window_mask,
+)
+from progen_tpu.ops.rotary import apply_rotary_pos_emb, fixed_pos_embedding, rotate_every_two
+from progen_tpu.ops.sgu import spatial_gate
+from progen_tpu.ops.shift import shift_tokens
+
+__all__ = [
+    "ATTN_MASK_VALUE",
+    "concat_previous_window",
+    "local_attention",
+    "window_mask",
+    "apply_rotary_pos_emb",
+    "fixed_pos_embedding",
+    "rotate_every_two",
+    "spatial_gate",
+    "shift_tokens",
+]

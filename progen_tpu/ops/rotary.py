@@ -1,0 +1,56 @@
+"""Rotary position embedding — interleaved (GPT-J) variant.
+
+Behavioral contract (reference ``/root/reference/progen_transformer/progen.py:24-41``):
+
+* frequencies ``1/10000^(2i/d)``, each repeated twice consecutively so the
+  sin/cos tables have shape ``(n, d)`` with pairs of equal entries;
+* rotation pairs ADJACENT channels: ``(x0, x1) -> (-x1, x0)``;
+* applied to the first ``rot_dim`` channels only, the rest pass through
+  (in the reference ``rot_dim == dim_head`` so the whole head rotates);
+* unusually, the reference rotates q, k AND v (``progen.py:87``) — we keep
+  that, it is load-bearing for behavior parity.
+
+All functions are shape-polymorphic over leading batch/head dims; the
+position axis is ``-2`` and the feature axis is ``-1``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fixed_pos_embedding(n: int, dim: int, dtype=jnp.float32):
+    """Sin/cos tables of shape ``(n, dim)`` (dim must be even).
+
+    Built in float32 regardless of compute dtype — trig tables in bf16 lose
+    position resolution at long context.
+    """
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = jnp.arange(n, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    # repeat each frequency twice consecutively: (n, dim/2) -> (n, dim)
+    angles = jnp.repeat(angles, 2, axis=-1)
+    return jnp.sin(angles).astype(dtype), jnp.cos(angles).astype(dtype)
+
+
+def rotate_every_two(x):
+    """``(..., x0, x1, x2, x3, ...) -> (..., -x1, x0, -x3, x2, ...)``."""
+    x = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+    x1, x2 = x[..., 0], x[..., 1]
+    out = jnp.stack((-x2, x1), axis=-1)
+    return out.reshape(*out.shape[:-2], -1)
+
+
+def apply_rotary_pos_emb(x, sin, cos):
+    """Rotate the first ``sin.shape[-1]`` channels of ``x``; pass the rest.
+
+    ``sin``/``cos`` are ``(n, rot_dim)`` and broadcast over leading dims of
+    ``x`` (``(..., n, d)``).
+    """
+    rot_dim = sin.shape[-1]
+    sin = sin.astype(x.dtype)
+    cos = cos.astype(x.dtype)
+    x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+    x_rot = (x_rot * cos) + (rotate_every_two(x_rot) * sin)
+    if x_pass.shape[-1] == 0:
+        return x_rot
+    return jnp.concatenate((x_rot, x_pass), axis=-1)

@@ -1,0 +1,37 @@
+"""Spatial gating unit core op (gMLP token mixing).
+
+Contract (reference ``/root/reference/progen_transformer/progen.py:166-185``):
+the gate half is mixed across positions by a LEARNED causal ``(n, n)``
+matrix: ``out[m] = sum_{n<=m} weights[m, n] * gate[n] + bias[m]``.  The
+reference writes this as ``einsum('n d, m n -> m d')`` with a ``tril`` mask
+on the weights.  This is dense O(n²) token mixing — on TPU it is a single
+big MXU matmul, which is exactly where it wants to live.
+
+The mask is applied to the weights (not the output), so gradients to the
+upper triangle are hard zeros — matching the reference's parameterization.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def causal_mask(n: int, dtype=jnp.float32):
+    return jnp.tril(jnp.ones((n, n), dtype=dtype))
+
+
+def spatial_gate(gate, weights, biases):
+    """Mix ``gate`` ``(..., n, d)`` with causal ``weights`` ``(n, n)`` and
+    ``biases`` ``(n, 1)``.
+
+    Weight masking and the matmul accumulate in f32 (MXU accumulator) —
+    the learned weights start at ~1e-6 scale (init U(±eps/n)), far below
+    bf16 resolution around 1.0.
+    """
+    n = weights.shape[0]
+    w = weights * causal_mask(n, weights.dtype)
+    mixed = jnp.einsum(
+        "...nd,mn->...md", gate, w, preferred_element_type=jnp.float32
+    )
+    mixed = mixed + biases
+    return mixed.astype(gate.dtype)

@@ -1,0 +1,23 @@
+"""Token shift: half the channels look one position back.
+
+Contract (reference ``/root/reference/progen_transformer/progen.py:43-46``):
+split channels in two with ``array_split`` semantics (first chunk gets the
+extra channel when the dim is odd), shift the FIRST half forward by one
+position (zero at position 0), concatenate back.  Applied at the top of both
+the attention and feed-forward blocks after their pre-LayerNorm.
+
+Batched: position axis is ``-2``, works for ``(B, L, D)`` or ``(L, D)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def shift_tokens(x):
+    d = x.shape[-1]
+    split = d - d // 2  # array_split: first chunk takes the remainder
+    x_shift, x_pass = x[..., :split], x[..., split:]
+    pad = [(0, 0)] * (x.ndim - 2) + [(1, 0), (0, 0)]
+    x_shift = jnp.pad(x_shift, pad)[..., :-1, :]
+    return jnp.concatenate((x_shift, x_pass), axis=-1)

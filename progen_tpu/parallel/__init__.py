@@ -1,0 +1,17 @@
+from progen_tpu.parallel.sharding import (
+    RULE_SETS,
+    batch_sharding,
+    logical_rules,
+    param_shardings,
+    replicated,
+    unbox,
+)
+
+__all__ = [
+    "RULE_SETS",
+    "batch_sharding",
+    "logical_rules",
+    "param_shardings",
+    "replicated",
+    "unbox",
+]

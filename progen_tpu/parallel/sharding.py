@@ -1,0 +1,103 @@
+"""Parallelism as rule tables: logical axis name -> mesh axis.
+
+The reference's one strategy is single-host ``pmap`` data parallelism
+(``/root/reference/progen_transformer/utils.py:69-91``).  Here every
+strategy is a mapping from the model's LOGICAL axis names (declared in
+``progen_tpu/models/progen.py`` via ``nn.with_logical_partitioning``) onto
+the four mesh axes from ``progen_tpu/core/mesh.py``:
+
+* ``dp``    — batch over ('data','fsdp'); params replicated.
+* ``fsdp``  — batch over ('data','fsdp'); every weight matrix sharded on its
+              'embed' (or row) axis over 'fsdp' (ZeRO-3: params, grads and
+              optimizer state all sharded; XLA all-gathers weights per layer).
+* ``tp``    — megatron-style: qkv/mlp column-parallel, out/proj row-parallel
+              over 'tensor'; activations sharded on heads/mlp.
+* ``sp``    — activations sharded along the sequence over 'seq'
+              (context parallelism).  Under plain pjit XLA inserts generic
+              collectives for the window structure; the explicit
+              halo-exchange path (``progen_tpu/parallel/context.py``,
+              shard_map + ppermute) is the optimized route.  The SGU
+              spatial weights shard row-wise.
+
+Strategies compose: rules are merged left-to-right, so ``("fsdp", "tp")``
+gives 2D sharding.  Unlisted logical axes are replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Each rule set: logical axis -> mesh axis (or tuple of mesh axes, or None).
+RULE_SETS: dict[str, list[tuple[str, Any]]] = {
+    "dp": [
+        ("act_batch", ("data", "fsdp")),
+    ],
+    "fsdp": [
+        ("act_batch", ("data", "fsdp")),
+        ("embed", "fsdp"),
+        ("vocab", "fsdp"),
+        ("spatial_row", "fsdp"),
+    ],
+    "tp": [
+        ("act_batch", ("data", "fsdp")),
+        ("qkv", "tensor"),
+        ("mlp", "tensor"),
+        ("act_heads", "tensor"),
+        ("act_mlp", "tensor"),
+    ],
+    "sp": [
+        ("act_batch", ("data", "fsdp")),
+        ("act_seq", "seq"),
+        ("spatial_row", "seq"),
+    ],
+}
+
+
+def logical_rules(strategies: Sequence[str] = ("dp",)) -> list[tuple[str, Any]]:
+    """Merge rule sets; later strategies must not contradict earlier ones
+    (first occurrence of a logical axis wins, matching flax rule semantics
+    where the first matching rule applies)."""
+    merged: list[tuple[str, Any]] = []
+    seen: set[str] = set()
+    for s in strategies:
+        for name, axis in RULE_SETS[s]:
+            if name not in seen:
+                merged.append((name, axis))
+                seen.add(name)
+    return merged
+
+
+def unbox(tree):
+    """Strip flax logical-partitioning metadata boxes -> plain arrays."""
+    return nn.meta.unbox(tree)
+
+
+def boxed_abstract_params(model, sample_tokens):
+    """Shape-only init (no FLOPs) keeping the logical-axis boxes."""
+    return jax.eval_shape(model.init, jax.random.key(0), sample_tokens)
+
+
+def param_logical_specs(model, sample_tokens):
+    """Pytree of logical PartitionSpecs for every parameter."""
+    return nn.get_partition_spec(boxed_abstract_params(model, sample_tokens))
+
+
+def param_shardings(model, sample_tokens, mesh: Mesh,
+                    strategies: Sequence[str] = ("dp",)):
+    """Pytree of NamedShardings for params under the given strategy mix."""
+    rules = logical_rules(strategies)
+    logical = param_logical_specs(model, sample_tokens)
+    return nn.logical_to_mesh_sharding(logical, mesh, rules)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Global batch layout: batch dim split over ('data','fsdp')."""
+    return NamedSharding(mesh, PartitionSpec(("data", "fsdp"), None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

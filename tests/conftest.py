@@ -1,0 +1,32 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This is the standard JAX trick for exercising pjit/shard_map multi-device
+semantics without hardware (SURVEY.md §4): the env vars must be set before
+jax (or anything importing jax) is imported, which is why they live at the
+top of conftest rather than in a fixture.
+"""
+
+import os
+
+# Force CPU even when the launch env preset JAX_PLATFORMS (e.g. to a real
+# TPU backend) — tests exercise multi-device semantics on virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The image's jax build defaults jax_platforms to the TPU tunnel backend and
+# ignores the env var; the config update (before any backend init) wins.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,90 @@
+"""Independent NumPy oracle for the model-core numerics contract.
+
+Written directly from the behavioral spec in SURVEY.md §2.a (float64,
+loop-based where that makes intent obvious).  Deliberately structured
+differently from both the reference and progen_tpu so that agreement is
+meaningful.
+"""
+
+import numpy as np
+
+
+def rotary_tables(n, dim):
+    half = dim // 2
+    freqs = 1.0 / (10000.0 ** (np.arange(half) * 2.0 / dim))
+    sin = np.zeros((n, dim))
+    cos = np.zeros((n, dim))
+    for pos in range(n):
+        for i in range(half):
+            a = pos * freqs[i]
+            sin[pos, 2 * i] = sin[pos, 2 * i + 1] = np.sin(a)
+            cos[pos, 2 * i] = cos[pos, 2 * i + 1] = np.cos(a)
+    return sin, cos
+
+
+def rotary_apply(x, sin, cos):
+    """x: (n, d); rotate first sin.shape[-1] channels, adjacent-pair style."""
+    rot = sin.shape[-1]
+    out = x.copy().astype(np.float64)
+    for pos in range(x.shape[0]):
+        for i in range(0, rot, 2):
+            x0, x1 = x[pos, i], x[pos, i + 1]
+            out[pos, i] = x0 * cos[pos, i] - x1 * sin[pos, i]
+            out[pos, i + 1] = x1 * cos[pos, i + 1] + x0 * sin[pos, i + 1]
+    return out
+
+
+def token_shift(x):
+    """x: (n, d). First ceil(d/2) channels take the previous position's value."""
+    n, d = x.shape
+    split = d - d // 2
+    out = x.copy().astype(np.float64)
+    out[0, :split] = 0.0
+    for pos in range(1, n):
+        out[pos, :split] = x[pos - 1, :split]
+    return out
+
+
+def local_attention(q, k, v, window):
+    """q,k,v: (n, d) single head. Query i attends keys j with:
+    prev_window_start(i) <= j <= i, where prev_window_start is the start of
+    the window before i's window (or 0-padding)."""
+    n, d = q.shape
+    out = np.zeros((n, d))
+    scale = d ** -0.5
+    for i in range(n):
+        w_start = (i // window) * window
+        lo = w_start - window  # may be negative -> zero-pad keys
+        js = [j for j in range(lo, i + 1)]
+        logits = np.array(
+            [q[i] @ k[j] * scale if j >= 0 else 0.0 * scale for j in js]
+        )
+        # zero-padded keys produce logit 0 and ARE attended (mask allows the
+        # whole previous window, incl. the pad window before window 0)
+        logits -= logits.max()
+        p = np.exp(logits)
+        p /= p.sum()
+        acc = np.zeros(d)
+        for pj, j in zip(p, js):
+            if j >= 0:
+                acc += pj * v[j]
+        out[i] = acc
+    return out
+
+
+def sgu_mix(gate, weights, biases):
+    """gate: (n, d), weights: (n, n), biases: (n, 1).
+    out[m] = sum_{j<=m} weights[m, j] * gate[j] + biases[m]."""
+    n, d = gate.shape
+    out = np.zeros((n, d))
+    for m in range(n):
+        for j in range(m + 1):
+            out[m] += weights[m, j] * gate[j]
+        out[m] += biases[m, 0]
+    return out
+
+
+def layernorm_scale_only(x, scale, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * scale

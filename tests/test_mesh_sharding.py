@@ -1,0 +1,101 @@
+"""Mesh construction and sharding-rule tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from progen_tpu.core import MeshConfig, make_mesh, single_device_mesh
+from progen_tpu.core.precision import make_policy
+from progen_tpu.models import ProGen, ProGenConfig
+from progen_tpu.parallel import logical_rules, param_shardings
+
+CFG = ProGenConfig(
+    num_tokens=64, dim=16, seq_len=32, depth=2, window_size=8,
+    global_mlp_depth=1, heads=2, dim_head=8, ff_mult=2,
+)
+
+
+def test_mesh_config_resolve_wildcard():
+    assert MeshConfig().resolve(8) == (8, 1, 1, 1)
+    assert MeshConfig(data=-1, tensor=2).resolve(8) == (4, 1, 2, 1)
+    assert MeshConfig(data=2, fsdp=2, tensor=2, seq=1).resolve(8) == (2, 2, 2, 1)
+
+
+def test_mesh_config_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).resolve(8)  # 3 does not divide 8
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).resolve(8)  # two wildcards
+    with pytest.raises(ValueError):
+        MeshConfig(data=2, fsdp=2, tensor=2, seq=2).resolve(8)  # needs 16
+
+
+def test_make_mesh_axes(devices8):
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices=devices8)
+    assert mesh.axis_names == ("data", "fsdp", "tensor", "seq")
+    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1}
+    single = single_device_mesh()
+    assert dict(single.shape) == {"data": 1, "fsdp": 1, "tensor": 1, "seq": 1}
+
+
+def test_logical_rules_merge_first_wins():
+    rules = dict(logical_rules(("fsdp", "tp")))
+    assert rules["embed"] == "fsdp"
+    assert rules["qkv"] == "tensor"
+    assert rules["act_batch"] == ("data", "fsdp")
+
+
+@pytest.mark.parametrize("strategies,axis,expect", [
+    (("dp",), "data", None),
+    (("fsdp",), "fsdp", "sharded"),
+    (("tp",), "tensor", "sharded"),
+])
+def test_param_shardings_strategies(devices8, strategies, axis, expect):
+    sizes = {"data": 1, "fsdp": 1, "tensor": 1, "seq": 1}
+    if expect == "sharded":
+        sizes[axis] = 8
+    else:
+        sizes["data"] = 8
+    mesh = make_mesh(MeshConfig(**{k: v for k, v in sizes.items()}),
+                     devices=devices8)
+    model = ProGen(config=CFG, policy=make_policy(False))
+    tokens = jnp.zeros((1, CFG.seq_len), jnp.int32)
+    shardings = param_shardings(model, tokens, mesh, strategies)
+    specs = jax.tree.leaves(
+        jax.tree.map(lambda s: s.spec, shardings,
+                     is_leaf=lambda x: hasattr(x, "spec"))
+    )
+    flat_axes = set()
+    for spec in specs:
+        for entry in spec:
+            if entry is None:
+                continue
+            entries = entry if isinstance(entry, tuple) else (entry,)
+            flat_axes.update(entries)
+    if expect == "sharded":
+        assert axis in flat_axes, f"no param sharded over {axis!r}: {specs[:4]}"
+    else:
+        assert flat_axes == set(), f"dp must replicate params, got {flat_axes}"
+
+
+def test_fsdp_sharded_init_runs_and_matches_replicated(devices8):
+    """Params initialized directly into an FSDP-sharded layout equal the
+    single-device init values (sharding must not change numerics)."""
+    mesh = make_mesh(MeshConfig(data=1, fsdp=8), devices=devices8)
+    model = ProGen(config=CFG, policy=make_policy(False))
+    tokens = jnp.zeros((2, CFG.seq_len), jnp.int32)
+    shardings = param_shardings(model, tokens, mesh, ("fsdp",))
+
+    def init_unboxed(key):
+        import flax.linen as nn
+        return nn.meta.unbox(model.init(key, tokens))
+
+    key = jax.random.key(0)
+    sharded = jax.jit(init_unboxed, out_shardings=shardings)(key)
+    plain = init_unboxed(key)
+    a = jax.tree.leaves(sharded)
+    b = jax.tree.leaves(plain)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-6)

@@ -1,0 +1,121 @@
+"""Model-level contract tests: shapes, causality, layer layout, precision."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.core.precision import make_policy
+from progen_tpu.models import ProGen, ProGenConfig
+from progen_tpu.parallel import unbox
+
+CFG = ProGenConfig(
+    num_tokens=64, dim=16, seq_len=32, depth=3, window_size=8,
+    global_mlp_depth=1, heads=2, dim_head=8, ff_mult=2, ff_glu=True,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = ProGen(config=CFG, policy=make_policy(mixed_precision=False))
+    tokens = jnp.zeros((2, CFG.seq_len), jnp.int32)
+    params = unbox(model.init(jax.random.key(0), tokens))
+    return model, params
+
+
+def test_output_shape_and_dtype(model_and_params):
+    model, params = model_and_params
+    tokens = jnp.ones((2, CFG.seq_len), jnp.int32)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, CFG.seq_len, CFG.num_tokens)
+    assert logits.dtype == jnp.float32
+
+
+def test_bf16_policy_keeps_params_f32_and_output_f32():
+    model = ProGen(config=CFG, policy=make_policy(mixed_precision=True))
+    tokens = jnp.zeros((1, CFG.seq_len), jnp.int32)
+    params = unbox(model.init(jax.random.key(0), tokens))
+    dtypes = {str(x.dtype) for x in jax.tree.leaves(params)}
+    assert dtypes == {"float32"}
+    logits = model.apply(params, tokens)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(model_and_params):
+    """Changing token at position j must not change logits at positions < j."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(1, CFG.num_tokens, (1, CFG.seq_len)))
+    base = model.apply(params, tokens)
+    for j in [0, 7, 8, 15, 20, 31]:  # incl. window boundaries (window=8)
+        perturbed = tokens.at[0, j].set((tokens[0, j] + 13) % CFG.num_tokens)
+        out = model.apply(params, perturbed)
+        np.testing.assert_allclose(
+            out[0, :j], base[0, :j], rtol=1e-5, atol=1e-5,
+            err_msg=f"leak from position {j}",
+        )
+        # and position j MUST see its own token (through shift at j+1... the
+        # logits at j predict token j+1 and depend on token j)
+        assert not np.allclose(out[0, j], base[0, j])
+
+
+def test_gmlp_in_last_layers_only(model_and_params):
+    _, params = model_and_params
+    p = params["params"]
+    # depth=3, global_mlp_depth=1 -> only the last layer (ff2) has the SGU
+    assert "sgu" not in p["ff0"] and "sgu" not in p["ff1"]
+    assert "sgu" in p["ff2"]
+    assert p["ff2"]["sgu"]["spatial_weights"].shape == (CFG.seq_len, CFG.seq_len)
+    assert p["ff2"]["sgu"]["spatial_biases"].shape == (CFG.seq_len, 1)
+    # GLU doubles proj_in hidden; SGU layer does not
+    assert p["ff0"]["proj_in"]["kernel"].shape[-1] == CFG.dim * CFG.ff_mult * 2
+    assert p["ff2"]["proj_in"]["kernel"].shape[-1] == CFG.dim * CFG.ff_mult
+
+
+def test_sgu_bias_init_is_ones(model_and_params):
+    _, params = model_and_params
+    b = params["params"]["ff2"]["sgu"]["spatial_biases"]
+    np.testing.assert_array_equal(np.asarray(b), np.ones_like(b))
+
+
+def test_sgu_weight_init_within_eps_over_n(model_and_params):
+    _, params = model_and_params
+    w = np.asarray(params["params"]["ff2"]["sgu"]["spatial_weights"])
+    bound = 1e-3 / CFG.seq_len
+    assert np.abs(w).max() <= bound
+    assert w.min() < 0 < w.max()  # recentered, not [0, scale)
+
+
+def test_qkv_has_no_bias(model_and_params):
+    _, params = model_and_params
+    attn = params["params"]["attn0"]
+    assert "bias" not in attn["to_qkv"]
+    assert "bias" in attn["to_out"]
+
+
+def test_norms_are_scale_only(model_and_params):
+    _, params = model_and_params
+    for layer in ("attn0", "ff0"):
+        norm = params["params"][layer]["norm"]
+        assert set(norm.keys()) == {"scale"}
+
+
+def test_config_from_dict_accepts_dead_reference_kwargs():
+    cfg = ProGenConfig.from_dict({
+        "num_tokens": 256, "dim": 128, "seq_len": 1024, "depth": 3,
+        "window_size": 512, "heads": 3, "dim_head": 32,
+        "clamp_gate": True, "attn_dim": None,  # dead in reference progen.py:201-202
+    })
+    assert cfg.dim == 128 and cfg.window_size == 512
+
+
+def test_mixed_precision_compute_is_bf16(model_and_params):
+    """Intermediate compute under the bf16 policy is actually bf16."""
+    model = ProGen(config=CFG, policy=make_policy(mixed_precision=True))
+    tokens = jnp.zeros((1, CFG.seq_len), jnp.int32)
+    params = unbox(model.init(jax.random.key(0), tokens))
+    _, intermediates = model.apply(
+        params, tokens, capture_intermediates=lambda mdl, name: name == "__call__"
+    )
+    attn_out = intermediates["intermediates"]["attn0"]["__call__"][0]
+    assert attn_out.dtype == jnp.bfloat16

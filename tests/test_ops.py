@@ -1,0 +1,123 @@
+"""Golden tests: progen_tpu ops vs the independent NumPy oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.ops import (
+    apply_rotary_pos_emb,
+    fixed_pos_embedding,
+    local_attention,
+    shift_tokens,
+    spatial_gate,
+    window_mask,
+)
+from tests import oracle
+
+RTOL = 1e-5
+ATOL = 1e-5
+
+
+def test_rotary_tables_match_oracle():
+    n, d = 12, 8
+    sin, cos = fixed_pos_embedding(n, d)
+    osin, ocos = oracle.rotary_tables(n, d)
+    np.testing.assert_allclose(sin, osin, rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(cos, ocos, rtol=RTOL, atol=ATOL)
+
+
+def test_rotary_apply_matches_oracle():
+    rng = np.random.default_rng(0)
+    n, d = 10, 8
+    x = rng.normal(size=(n, d))
+    sin, cos = fixed_pos_embedding(n, d)
+    got = apply_rotary_pos_emb(jnp.asarray(x, jnp.float32), sin, cos)
+    want = oracle.rotary_apply(x, np.asarray(sin), np.asarray(cos))
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_rotary_partial_dim_passthrough():
+    rng = np.random.default_rng(1)
+    n, d, rot = 6, 10, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    sin, cos = fixed_pos_embedding(n, rot)
+    got = apply_rotary_pos_emb(jnp.asarray(x), sin, cos)
+    np.testing.assert_allclose(got[:, rot:], x[:, rot:], rtol=0, atol=0)
+
+
+def test_rotary_batched_equals_per_row():
+    rng = np.random.default_rng(2)
+    b, h, n, d = 2, 3, 8, 4
+    x = rng.normal(size=(b, h, n, d)).astype(np.float32)
+    sin, cos = fixed_pos_embedding(n, d)
+    got = apply_rotary_pos_emb(jnp.asarray(x), sin, cos)
+    for bi in range(b):
+        for hi in range(h):
+            want = oracle.rotary_apply(x[bi, hi], np.asarray(sin), np.asarray(cos))
+            np.testing.assert_allclose(got[bi, hi], want, rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("d", [8, 7])  # even and odd channel counts
+def test_shift_tokens_matches_oracle(d):
+    rng = np.random.default_rng(3)
+    n = 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    got = shift_tokens(jnp.asarray(x)[None])[0]
+    want = oracle.token_shift(x)
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_window_mask_shape_and_semantics():
+    wsz = 4
+    m = np.asarray(window_mask(wsz))
+    assert m.shape == (wsz, 2 * wsz)
+    for i in range(wsz):
+        for j in range(2 * wsz):
+            # key j (0..wsz-1 = previous window, wsz..2wsz-1 = own window)
+            # visible iff j <= i + wsz
+            assert m[i, j] == (j <= i + wsz)
+
+
+@pytest.mark.parametrize("n,wsz", [(8, 4), (16, 4), (12, 6)])
+def test_local_attention_matches_oracle(n, wsz):
+    rng = np.random.default_rng(4)
+    d = 8
+    q, k, v = (rng.normal(size=(n, d)).astype(np.float32) for _ in range(3))
+    got = local_attention(
+        jnp.asarray(q)[None, None], jnp.asarray(k)[None, None],
+        jnp.asarray(v)[None, None], window_size=wsz,
+    )[0, 0]
+    want = oracle.local_attention(q, k, v, wsz)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_local_attention_rejects_bad_length():
+    x = jnp.zeros((1, 1, 10, 4))
+    with pytest.raises(ValueError):
+        local_attention(x, x, x, window_size=4)
+
+
+def test_sgu_mix_matches_oracle():
+    rng = np.random.default_rng(5)
+    n, d = 7, 5
+    gate = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(n, n)).astype(np.float32)
+    b = rng.normal(size=(n, 1)).astype(np.float32)
+    got = spatial_gate(jnp.asarray(gate)[None], jnp.asarray(w), jnp.asarray(b))[0]
+    want = oracle.sgu_mix(gate, w, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_sgu_upper_triangle_is_dead():
+    """Weights above the diagonal must not affect the output (causal mask
+    applied to weights, not output)."""
+    rng = np.random.default_rng(6)
+    n, d = 6, 4
+    gate = jnp.asarray(rng.normal(size=(1, n, d)), jnp.float32)
+    b = jnp.zeros((n, 1))
+    w1 = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+    w2 = w1 + jnp.triu(jnp.ones((n, n)), k=1) * 100.0
+    np.testing.assert_allclose(
+        spatial_gate(gate, w1, b), spatial_gate(gate, w2, b), rtol=0, atol=0
+    )
