@@ -1,0 +1,14 @@
+from progen_tpu.train.loss import batch_loss, cross_entropy, eos_from_pad_mask
+from progen_tpu.train.optimizer import decay_mask, make_optimizer
+from progen_tpu.train.step import TrainFunctions, TrainState, make_train_functions
+
+__all__ = [
+    "batch_loss",
+    "cross_entropy",
+    "eos_from_pad_mask",
+    "decay_mask",
+    "make_optimizer",
+    "TrainFunctions",
+    "TrainState",
+    "make_train_functions",
+]

@@ -1,0 +1,46 @@
+"""Next-token loss with the EOS-from-padding trick.
+
+Contract (reference ``/root/reference/progen_transformer/utils.py:42-65``):
+
+* data rows are ``(seq_len + 1,)`` wide (BOS column prepended by the data
+  pipeline); inputs are ``data[:-1]``, targets ``data[1:]``;
+* token id 0 is padding; the loss mask keeps every non-pad target PLUS the
+  FIRST pad position, so the model learns to emit 0 as end-of-sequence;
+* loss is the masked mean of the per-token NLL within each row, then the
+  plain mean over rows.
+
+Natively batched ``(B, L)`` logits/targets — the reference gets batching
+from an outer vmap (``utils.py:67``); the math per row is identical.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def eos_from_pad_mask(targets, ignore_index: int = 0):
+    """Bool mask over targets: non-pad positions plus the first pad."""
+    nonpad = targets != ignore_index
+    first_pad = jnp.cumsum(~nonpad, axis=-1) == 1
+    return nonpad | first_pad
+
+
+def cross_entropy(logits, targets, ignore_index: int = 0):
+    """Per-row masked-mean NLL: ``(B, L, V) x (B, L) -> (B,)``.
+
+    Computed in f32 regardless of logits dtype — the log-softmax reduction
+    is precision-sensitive.
+    """
+    logits = logits.astype(jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = jnp.take_along_axis(logprobs, targets[..., None].astype(jnp.int32),
+                              axis=-1)[..., 0]
+    mask = eos_from_pad_mask(targets, ignore_index)
+    per_row = -(nll * mask).sum(axis=-1) / mask.sum(axis=-1)
+    return per_row
+
+
+def batch_loss(logits, targets, ignore_index: int = 0):
+    """Scalar training loss: mean over rows of the per-row masked CE."""
+    return cross_entropy(logits, targets, ignore_index).mean()

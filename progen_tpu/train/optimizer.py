@@ -1,0 +1,50 @@
+"""Optimizer assembly.
+
+Contract (reference ``/root/reference/train.py:117-123``): global-norm clip
+0.5 -> AdamW (lr 2e-4, weight decay 1e-3, decay mask ``ndim > 1`` so
+LayerNorm scales and biases are excluded) -> gradient accumulation every N
+micro-batches.  No LR schedule, no warmup (reference has none; a schedule
+hook is exposed for the TPU build's larger configs).
+
+Conscious change from the reference: accumulation uses ``optax.MultiSteps``
+(accumulate GRADIENTS, run clip+adamw once per effective batch) instead of
+``optax.apply_every`` (which accumulates post-Adam UPDATES and advances Adam
+moments every micro-batch).  MultiSteps is the mathematically standard
+large-batch semantics and is what ``apply_every``'s own docs recommend.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+
+
+def decay_mask(params):
+    """True where weight decay applies: every param with ndim > 1
+    (reference ``train.py:117``)."""
+    return jax.tree.map(lambda x: x.ndim > 1, params)
+
+
+def make_optimizer(
+    learning_rate: float | Callable = 2e-4,
+    weight_decay: float = 1e-3,
+    max_grad_norm: float = 0.5,
+    grad_accum_every: int = 1,
+    b1: float = 0.9,
+    b2: float = 0.999,
+) -> optax.GradientTransformation:
+    tx = optax.chain(
+        optax.clip_by_global_norm(max_grad_norm),
+        optax.adamw(
+            learning_rate,
+            b1=b1,
+            b2=b2,
+            weight_decay=weight_decay,
+            mask=decay_mask,
+        ),
+    )
+    if grad_accum_every > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=grad_accum_every)
+    return tx

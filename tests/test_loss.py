@@ -1,0 +1,75 @@
+"""Loss contract tests: EOS-from-pad masking semantics (SURVEY.md §2.b)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from progen_tpu.train.loss import batch_loss, cross_entropy, eos_from_pad_mask
+
+
+def test_mask_keeps_first_pad_only():
+    targets = jnp.asarray([[5, 3, 0, 0, 0]])
+    mask = eos_from_pad_mask(targets)
+    np.testing.assert_array_equal(np.asarray(mask[0]),
+                                  [True, True, True, False, False])
+
+
+def test_mask_no_padding_row():
+    targets = jnp.asarray([[5, 3, 2, 7, 1]])
+    mask = eos_from_pad_mask(targets)
+    assert bool(mask.all())
+
+
+def test_mask_all_pad_row_keeps_one():
+    targets = jnp.asarray([[0, 0, 0]])
+    mask = eos_from_pad_mask(targets)
+    np.testing.assert_array_equal(np.asarray(mask[0]), [True, False, False])
+
+
+def test_mask_interior_zero_acts_as_eos():
+    # a zero mid-row starts the "pad" region: only its first occurrence kept
+    targets = jnp.asarray([[5, 0, 3, 0, 2]])
+    mask = eos_from_pad_mask(targets)
+    # cumsum of (t==0): [0,1,1,2,2] -> first-pad is index 1 only
+    np.testing.assert_array_equal(np.asarray(mask[0]),
+                                  [True, True, True, False, True])
+
+
+def test_cross_entropy_matches_manual():
+    rng = np.random.default_rng(0)
+    B, L, V = 2, 6, 11
+    logits = rng.normal(size=(B, L, V)).astype(np.float32)
+    targets = np.array([[4, 2, 9, 0, 0, 0], [1, 1, 1, 1, 1, 1]])
+    got = cross_entropy(jnp.asarray(logits), jnp.asarray(targets))
+    # manual: log-softmax, gather, mask = nonpad | first-pad, per-row mean
+    want = []
+    for b in range(B):
+        lp = logits[b] - logits[b].max(-1, keepdims=True)
+        lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+        nll = np.array([lp[i, targets[b, i]] for i in range(L)])
+        nonpad = targets[b] != 0
+        first_pad = np.cumsum(~nonpad) == 1
+        m = nonpad | first_pad
+        want.append(-(nll * m).sum() / m.sum())
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+def test_batch_loss_is_mean_of_rows():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(3, 4, 7)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 7, (3, 4)))
+    rows = cross_entropy(logits, targets)
+    np.testing.assert_allclose(batch_loss(logits, targets), rows.mean(),
+                               rtol=1e-6, atol=0)
+
+
+def test_loss_invariant_to_tokens_after_first_pad():
+    """Logit content at positions after the first pad must not change loss."""
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.normal(size=(1, 6, 9)), jnp.float32)
+    targets = jnp.asarray([[3, 2, 0, 0, 0, 0]])
+    base = batch_loss(logits, targets)
+    # perturb logits at masked positions (3..5)
+    perturbed = logits.at[:, 3:, :].add(7.0)
+    np.testing.assert_allclose(batch_loss(perturbed, targets), base,
+                               rtol=1e-6, atol=1e-6)
