@@ -1,0 +1,3 @@
+from progen_tpu.checkpoint.store import CheckpointStore, abstract_state_like
+
+__all__ = ["CheckpointStore", "abstract_state_like"]

@@ -1,0 +1,130 @@
+"""Sharded checkpoint store (orbax/tensorstore).
+
+Logical contents match the reference's cloudpickled package
+(``/root/reference/train.py:202-208``): ``next_seq_index`` (data-stream
+resume cursor), ``params`` + ``optimizer state`` (here inside a
+``TrainState``), ``model_config``, and ``run_id`` (experiment-tracker
+resume).  The reference writes UNSHARDED full-state pickles
+(``checkpoint.py:30-31``); a pod-scale model cannot materialize on one
+host, so this store writes each array shard from the host that owns it
+(orbax -> tensorstore) and restores directly into the requested sharding.
+
+Behavioral parity points:
+
+* local paths and ``gs://`` both work (reference ``checkpoint.py:85-109``
+  dispatches the same way; orbax handles GCS natively, no /tmp staging or
+  manual timeouts needed);
+* keep-last-N pruning (reference ``checkpoint.py:33-37``, default 500);
+* ``reset()`` wipes the store (reference ``checkpoint.py:12-13,44-45``) —
+  the y/n confirm lives in the CLI, not here;
+* checkpoints are identified by TRAINING STEP (monotonic), replacing the
+  reference's unix-time filenames whose lexicographic ordering breaks
+  across epoch boundaries of 10^k seconds.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+from etils import epath
+
+
+class CheckpointStore:
+    def __init__(self, path: str, keep_last_n: int | None = 500):
+        self._path = epath.Path(path)
+        self._keep_last_n = keep_last_n
+        self._mgr: ocp.CheckpointManager | None = None
+
+    # lazily (re)create so reset() can drop the directory out from under us
+    def _manager(self) -> ocp.CheckpointManager:
+        if self._mgr is None:
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=self._keep_last_n,
+                create=True,
+                enable_async_checkpointing=False,
+            )
+            self._mgr = ocp.CheckpointManager(self._path, options=options)
+        return self._mgr
+
+    def reset(self) -> None:
+        """Delete every checkpoint (reference 'reset' semantics)."""
+        if self._mgr is not None:
+            self._mgr.close()
+            self._mgr = None
+        if self._path.exists():
+            self._path.rmtree()
+
+    def latest_step(self) -> int | None:
+        return self._manager().latest_step()
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        next_seq_index: int,
+        model_config: dict,
+        run_id: str | None = None,
+    ) -> None:
+        meta = {
+            "next_seq_index": int(next_seq_index),
+            "model_config": model_config,
+            "run_id": run_id,
+        }
+        mgr = self._manager()
+        mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+        mgr.wait_until_finished()
+
+    def restore_meta(self, step: int | None = None) -> dict | None:
+        """Metadata only — enough to rebuild the model/config before the
+        (potentially sharded) state restore."""
+        mgr = self._manager()
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            return None
+        out = mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+        return dict(out["meta"])
+
+    def restore_state(self, abstract_state: Any, step: int | None = None):
+        """Restore the train state.
+
+        ``abstract_state`` is a pytree of ``jax.ShapeDtypeStruct`` (with
+        ``sharding`` set for a sharded restore) matching what was saved —
+        build it with ``jax.eval_shape`` over the state factory.
+        """
+        mgr = self._manager()
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            return None
+        out = mgr.restore(
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract_state)),
+        )
+        return out["state"]
+
+    def close(self) -> None:
+        if self._mgr is not None:
+            self._mgr.close()
+            self._mgr = None
+
+
+def abstract_state_like(fns, key=None):
+    """Abstract (shape/dtype/sharding) pytree for ``restore_state`` from a
+    :class:`~progen_tpu.train.step.TrainFunctions` bundle."""
+    key = key if key is not None else jax.random.key(0)
+    abstract = jax.eval_shape(fns.init_state, key)
+    if fns.state_shardings is not None:
+        abstract = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            abstract,
+            fns.state_shardings,
+        )
+    return abstract

@@ -1,0 +1,35 @@
+from progen_tpu.data.tokenizer import (
+    OFFSET,
+    PAD_ID,
+    VOCAB_SIZE,
+    decode_token,
+    decode_tokens,
+    encode_token,
+    encode_tokens,
+)
+from progen_tpu.data.tfrecord import (
+    collate,
+    count_sequences,
+    iterator_from_tfrecords_folder,
+    list_shards,
+    parse_shard_filename,
+    shard_filename,
+    write_tfrecord,
+)
+
+__all__ = [
+    "OFFSET",
+    "PAD_ID",
+    "VOCAB_SIZE",
+    "decode_token",
+    "decode_tokens",
+    "encode_token",
+    "encode_tokens",
+    "collate",
+    "count_sequences",
+    "iterator_from_tfrecords_folder",
+    "list_shards",
+    "parse_shard_filename",
+    "shard_filename",
+    "write_tfrecord",
+]

@@ -1,0 +1,168 @@
+"""TFRecord protein-sequence pipeline — SPMD-aware reader + writer.
+
+Format contract (reference ``/root/reference/progen_transformer/data.py``):
+
+* records are GZIP TFRecords with ONE bytes feature ``'seq'`` holding the
+  raw UTF-8 sequence string (``data.py:9-21``);
+* filename protocol ``{file_index}.{num_sequences}.{train|valid}.tfrecord.gz``;
+  the reader derives corpus size by summing the ``num_sequences`` field
+  (``data.py:46``);
+* collation (``data.py:30-35,64-70``): bytes -> ints, truncate to
+  ``seq_len``, +1 tokenizer offset applied AT COLLATE TIME (tfrecords store
+  raw bytes), right-pad with 0, prepend a zero BOS column ->
+  ``(B, seq_len + 1)``;
+* resume-by-skip: ``skip`` consumed records before batching
+  (``data.py:56``) — correct across batch-size changes.
+
+TPU/SPMD additions (no counterpart in the single-process reference):
+
+* ``process_count``/``process_index`` shard the RECORD stream across hosts
+  (record-level round-robin, so per-host skip arithmetic stays exact:
+  global ``skip`` maps to ``skip // process_count`` per host — every host
+  must be fed the same global skip);
+* batches come out int32 (TPU-native index dtype) rather than uint16.
+
+TensorFlow is imported lazily and used only for file IO (tf.data never
+touches the accelerator; ``tf.config.set_visible_devices([], 'GPU'|'TPU')``
+guards against it grabbing the chip).
+"""
+
+from __future__ import annotations
+
+import functools
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+from progen_tpu.data.tokenizer import OFFSET
+
+
+@functools.lru_cache(maxsize=1)
+def _tf():
+    import tensorflow as tf
+
+    # tf.data must never claim the accelerator.
+    for kind in ("GPU", "TPU"):
+        try:
+            tf.config.set_visible_devices([], kind)
+        except Exception:
+            pass
+    return tf
+
+
+# ---------------------------------------------------------------------------
+# writing
+
+
+def shard_filename(file_index: int, num_sequences: int, data_type: str) -> str:
+    """The reference's filename protocol (generate_data.py:142)."""
+    return f"{file_index}.{num_sequences}.{data_type}.tfrecord.gz"
+
+
+def parse_shard_filename(name: str) -> int:
+    """Number of sequences encoded in a shard filename (data.py:46)."""
+    return int(name.split(".")[-4])
+
+
+def write_tfrecord(path: str, payloads) -> int:
+    """Write raw byte payloads as GZIP TFRecords with the 'seq' feature.
+
+    Returns the number of records written.
+    """
+    tf = _tf()
+    options = tf.io.TFRecordOptions(compression_type="GZIP")
+    n = 0
+    with tf.io.TFRecordWriter(str(path), options=options) as writer:
+        for payload in payloads:
+            ex = tf.train.Example(
+                features=tf.train.Features(
+                    feature={
+                        "seq": tf.train.Feature(
+                            bytes_list=tf.train.BytesList(value=[payload])
+                        )
+                    }
+                )
+            )
+            writer.write(ex.SerializeToString())
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# reading
+
+
+def list_shards(folder: str, data_type: str = "train") -> list[str]:
+    """Shard files for a split, local or ``gs://`` (sorted for determinism;
+    the reference relies on glob order, which is unstable — sorting is a
+    conscious fix)."""
+    if folder.startswith("gs://"):
+        tf = _tf()
+        names = tf.io.gfile.glob(f"{folder}/*.{data_type}.tfrecord.gz")
+    else:
+        names = [str(p) for p in Path(folder).glob(f"**/*.{data_type}.tfrecord.gz")]
+    return sorted(names)
+
+
+def count_sequences(folder: str, data_type: str = "train") -> int:
+    return sum(parse_shard_filename(n) for n in list_shards(folder, data_type))
+
+
+def collate(raw_seqs: list[bytes], seq_len: int, offset: int = OFFSET) -> np.ndarray:
+    """Raw byte strings -> ``(B, seq_len + 1)`` int32 with BOS column."""
+    batch = np.zeros((len(raw_seqs), seq_len + 1), dtype=np.int32)
+    for i, raw in enumerate(raw_seqs):
+        toks = np.frombuffer(raw, dtype=np.uint8)[:seq_len].astype(np.int32) + offset
+        batch[i, 1 : 1 + len(toks)] = toks
+    return batch
+
+
+def iterator_from_tfrecords_folder(
+    folder: str,
+    data_type: str = "train",
+):
+    """Returns ``(num_seqs, iter_fn)`` — the reference's reader factory
+    signature (``data.py:37-72``) with multi-host kwargs added to
+    ``iter_fn``.
+    """
+    filenames = list_shards(folder, data_type)
+    num_seqs = sum(parse_shard_filename(n) for n in filenames)
+
+    def iter_fn(
+        seq_len: int,
+        batch_size: int,
+        skip: int = 0,
+        loop: bool = False,
+        process_count: int = 1,
+        process_index: int = 0,
+        shuffle_buffer: int = 0,
+        seed: int = 0,
+    ) -> Iterator[np.ndarray]:
+        tf = _tf()
+        if skip % process_count != 0:
+            raise ValueError(
+                f"global skip {skip} must be a multiple of process_count "
+                f"{process_count} (checkpoint next_seq_index is aligned to "
+                "the global batch, which is host-divisible)"
+            )
+        ds = tf.data.TFRecordDataset(filenames, compression_type="GZIP")
+        if process_count > 1:
+            ds = ds.shard(process_count, process_index)
+        ds = ds.skip(skip // process_count)
+        ds = ds.map(
+            lambda rec: tf.io.parse_single_example(
+                rec, {"seq": tf.io.FixedLenFeature([], tf.string)}
+            )["seq"],
+            num_parallel_calls=tf.data.AUTOTUNE,
+        )
+        if shuffle_buffer:
+            ds = ds.shuffle(shuffle_buffer, seed=seed, reshuffle_each_iteration=True)
+        ds = ds.batch(batch_size)
+        ds = ds.prefetch(tf.data.AUTOTUNE)
+        if loop:
+            ds = ds.repeat()
+        for raw in ds.as_numpy_iterator():
+            yield collate(list(raw), seq_len)
+
+    return num_seqs, iter_fn

@@ -1,0 +1,16 @@
+from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
+from progen_tpu.decode.sampler import (
+    gumbel_topk_sample,
+    make_sampler,
+    teacher_forced_logits,
+    truncate_after_eos,
+)
+
+__all__ = [
+    "ProGenDecodeStep",
+    "init_caches",
+    "gumbel_topk_sample",
+    "make_sampler",
+    "teacher_forced_logits",
+    "truncate_after_eos",
+]

@@ -1,0 +1,273 @@
+"""Incremental (single-token) decode step with O(window) attention cache.
+
+The reference samples by re-running the FULL forward over the whole padded
+sequence for every generated token (``/root/reference/progen_transformer/
+utils.py:106-135``) — O(L) full forwards, O(L²·w) total attention work.
+SURVEY.md §2.c calls for a scan-based cached decoder; this module is the
+per-token step, designed around the model's three kinds of sequence state:
+
+* **token shift** needs the previous position's POST-NORM activations in
+  each block -> one ``(B, dim)`` carry per block;
+* **local windowed attention** at position i attends keys in
+  ``[prev_window_start(i), i]`` — at most ``2*window`` positions -> a RING
+  BUFFER of post-rotary k/v per layer, slot ``pos % (2*window)``.  Which
+  slots are valid is closed-form from (pos, slot), no position cache:
+  slot s holds ``p_s = pos - ((pos - s) mod 2w)``; it is attendable iff
+  ``p_s >= window_start(pos) - window`` (negative p_s = the reference's
+  phantom zero-pad window before position 0, reproduced by the zero-
+  initialized ring slots);
+* **SGU/gMLP** mixes ALL previous positions through a learned causal row
+  -> a ``(B, seq_len, hidden/2)`` cache of normed gate activations per
+  gMLP layer; step m contracts the cache with weight row m (masked to
+  ``n <= m``).
+
+Module/parameter names exactly mirror ``progen_tpu.models.progen.ProGen``
+(``attn{i}``/``ff{i}``/``embed``/``norm_out``/``to_logits`` with identical
+submodule names), so trained parameters bind directly to the decode graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.models.progen import ProGenConfig, _dense, _norm
+from progen_tpu.ops.local_attention import ATTN_MASK_VALUE
+from progen_tpu.ops.rotary import fixed_pos_embedding, rotate_every_two
+
+
+def _shift_with_carry(h, prev):
+    """Token shift at one position: the first ceil(d/2) channels come from
+    the previous position (``ops/shift.py`` semantics, incremental)."""
+    d = h.shape[-1]
+    split = d - d // 2
+    return jnp.concatenate([prev[..., :split], h[..., split:]], axis=-1)
+
+
+def _rotate_at(x, sin_row, cos_row):
+    """Rotary for one position: ``x (..., d)``, table rows ``(d,)``."""
+    return x * cos_row + rotate_every_two(x) * sin_row
+
+
+def init_caches(config: ProGenConfig, batch_size: int,
+                policy: Policy | None = None) -> dict:
+    """Zero caches for a fresh decode (a plain pytree, scan-friendly)."""
+    c = config
+    pol = policy or make_policy()
+    dt = pol.compute_dtype
+    ring = 2 * c.window_size
+    return {
+        "attn_prev": [jnp.zeros((batch_size, c.dim), dt) for _ in range(c.depth)],
+        "ff_prev": [jnp.zeros((batch_size, c.dim), dt) for _ in range(c.depth)],
+        "k": [jnp.zeros((batch_size, c.heads, ring, c.dim_head), dt)
+              for _ in range(c.depth)],
+        "v": [jnp.zeros((batch_size, c.heads, ring, c.dim_head), dt)
+              for _ in range(c.depth)],
+        "sgu_gate": {
+            str(i): jnp.zeros((batch_size, c.seq_len, (c.dim * c.ff_mult) // 2), dt)
+            for i in range(c.depth) if c.layer_uses_gmlp(i)
+        },
+    }
+
+
+class LocalAttentionDecode(nn.Module):
+    """One-position attention against the k/v ring buffer."""
+
+    dim: int
+    window_size: int
+    heads: int
+    dim_head: int
+    shift: bool
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x, sin_row, cos_row, slot, valid, prev, k_cache, v_cache):
+        h, d = self.heads, self.dim_head
+        inner = h * d
+        b = x.shape[0]
+
+        normed = _norm(self.policy, name="norm")(x)
+        new_prev = normed
+        if self.shift:
+            normed = _shift_with_carry(normed, prev)
+
+        qkv = _dense(inner * 3, use_bias=False, axes=("embed", "qkv"),
+                     policy=self.policy, name="to_qkv")(normed)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(b, h, d) for t in (q, k, v))
+        q, k, v = (_rotate_at(t, sin_row, cos_row) for t in (q, k, v))
+
+        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k, slot, axis=2)
+        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v, slot, axis=2)
+
+        sim = jnp.einsum("bhd,bhsd->bhs", q, k_cache,
+                         preferred_element_type=jnp.float32) * (d ** -0.5)
+        sim = jnp.where(valid[None, None, :], sim, ATTN_MASK_VALUE)
+        attn = jax.nn.softmax(sim, axis=-1).astype(v_cache.dtype)
+        out = jnp.einsum("bhs,bhsd->bhd", attn, v_cache).reshape(b, inner)
+        out = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
+                     policy=self.policy, name="to_out")(out)
+        return out, new_prev, k_cache, v_cache
+
+
+class SGUDecode(nn.Module):
+    """One-position spatial gate: contract the gate cache with weight row m."""
+
+    seq_len: int
+    dim_out: int
+    policy: Policy
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x, pos, gate_cache):
+        n = self.seq_len
+        x, gate = jnp.split(x, 2, axis=-1)
+        gate = _norm(self.policy, name="norm")(gate)
+
+        init_scale = self.eps / n
+
+        def symmetric_uniform(key, shape, dtype):
+            return jax.random.uniform(key, shape, dtype,
+                                      minval=-init_scale, maxval=init_scale)
+
+        weights = self.param("spatial_weights", symmetric_uniform, (n, n),
+                             self.policy.param_dtype)
+        biases = self.param("spatial_biases", nn.initializers.ones, (n, 1),
+                            self.policy.param_dtype)
+
+        gate_cache = jax.lax.dynamic_update_index_in_dim(
+            gate_cache, gate, pos, axis=1
+        )
+        w_row = jax.lax.dynamic_index_in_dim(
+            weights.astype(jnp.float32), pos, axis=0, keepdims=False
+        )  # (n,)
+        causal = (jnp.arange(n) <= pos).astype(jnp.float32)
+        w_row = w_row * causal
+        mixed = jnp.einsum("bnd,n->bd", gate_cache.astype(jnp.float32), w_row)
+        bias_m = jax.lax.dynamic_index_in_dim(
+            biases.astype(jnp.float32), pos, axis=0, keepdims=False
+        )  # (1,)
+        mixed = (mixed + bias_m).astype(x.dtype)
+
+        x = x * mixed
+        out = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
+                     policy=self.policy, name="proj_out")(x)
+        return out, gate_cache
+
+
+class FeedForwardDecode(nn.Module):
+    dim: int
+    seq_len: int
+    ff_mult: int
+    glu: bool
+    use_sgu: bool
+    shift: bool
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x, pos, prev, gate_cache):
+        hidden = self.dim * self.ff_mult * (2 if self.glu else 1)
+
+        normed = _norm(self.policy, name="norm")(x)
+        new_prev = normed
+        if self.shift:
+            normed = _shift_with_carry(normed, prev)
+
+        h = _dense(hidden, use_bias=True, axes=("embed", "mlp"),
+                   policy=self.policy, name="proj_in")(normed)
+        if self.glu:
+            h, gate = jnp.split(h, 2, axis=-1)
+            h = h * nn.gelu(gate)
+        else:
+            h = nn.gelu(h)
+
+        if self.use_sgu:
+            h, gate_cache = SGUDecode(
+                seq_len=self.seq_len, dim_out=hidden // 2,
+                policy=self.policy, name="sgu",
+            )(h, pos, gate_cache)
+
+        out = _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
+                     policy=self.policy, name="proj_out")(h)
+        return out, new_prev, gate_cache
+
+
+class ProGenDecodeStep(nn.Module):
+    """One decode step: ``(tok (B,), pos, caches) -> (logits (B, V), caches)``.
+
+    ``pos`` is a traced scalar; every shape is static, so the step nests
+    under ``lax.scan``/``jit`` without retracing.
+    """
+
+    config: ProGenConfig
+    policy: Policy = dataclasses.field(default_factory=make_policy)
+
+    @nn.compact
+    def __call__(self, tok, pos, caches):
+        cfg, pol = self.config, self.policy
+        wsz = cfg.window_size
+        ring = 2 * wsz
+
+        x = nn.Embed(
+            cfg.num_tokens, cfg.dim,
+            dtype=pol.compute_dtype, param_dtype=pol.param_dtype,
+            embedding_init=nn.initializers.variance_scaling(
+                1.0, "fan_in", "normal", out_axis=0),
+            name="embed",
+        )(tok)
+
+        sin_t, cos_t = fixed_pos_embedding(cfg.seq_len, cfg.dim_head)
+        sin_row = sin_t[pos].astype(pol.compute_dtype)
+        cos_row = cos_t[pos].astype(pol.compute_dtype)
+        slot = pos % ring
+
+        s = jnp.arange(ring)
+        p_s = pos - jnp.mod(pos - s, ring)
+        w_start = (pos // wsz) * wsz
+        # NOTE no ``p_s >= 0`` clause: in window 0 the reference attends a
+        # phantom ZERO-pad previous window (progen.py:90-95) whose keys
+        # contribute exp(0 - max) to the softmax denominator; ring slots
+        # with negative p_s are untouched zeros, which reproduces that
+        # exactly.
+        valid = p_s >= w_start - wsz
+
+        new: dict[str, Any] = {
+            "attn_prev": list(caches["attn_prev"]),
+            "ff_prev": list(caches["ff_prev"]),
+            "k": list(caches["k"]),
+            "v": list(caches["v"]),
+            "sgu_gate": dict(caches["sgu_gate"]),
+        }
+
+        for i in range(cfg.depth):
+            use_gmlp = cfg.layer_uses_gmlp(i)
+            attn_out, new["attn_prev"][i], new["k"][i], new["v"][i] = (
+                LocalAttentionDecode(
+                    dim=cfg.dim, window_size=wsz, heads=cfg.heads,
+                    dim_head=cfg.dim_head, shift=cfg.shift_tokens,
+                    policy=pol, name=f"attn{i}",
+                )(x, sin_row, cos_row, slot, valid,
+                  caches["attn_prev"][i], caches["k"][i], caches["v"][i])
+            )
+            x = x + attn_out
+
+            gate_cache = caches["sgu_gate"].get(str(i))
+            ff_out, new["ff_prev"][i], gate_cache = FeedForwardDecode(
+                dim=cfg.dim, seq_len=cfg.seq_len, ff_mult=cfg.ff_mult,
+                glu=(not use_gmlp) and cfg.ff_glu, use_sgu=use_gmlp,
+                shift=cfg.shift_tokens, policy=pol, name=f"ff{i}",
+            )(x, pos, caches["ff_prev"][i],
+              gate_cache if gate_cache is not None else jnp.zeros(()))
+            x = x + ff_out
+            if str(i) in new["sgu_gate"]:
+                new["sgu_gate"][str(i)] = gate_cache
+
+        h = _norm(pol, name="norm_out")(x)
+        logits = _dense(cfg.num_tokens, use_bias=True, axes=("embed", "vocab"),
+                        policy=pol, name="to_logits")(h)
+        return pol.cast_to_output(logits), new

@@ -1,0 +1,124 @@
+"""Autoregressive sampler: one ``lax.scan`` over positions, cached decode.
+
+Capability parity with the reference sampler (``/root/reference/
+progen_transformer/utils.py:97-135`` and call sites ``train.py:219-228``,
+``sample.py:64-73``): prime teacher-forcing, optional prepended BOS, top-k
+gumbel-max sampling, truncation after the second zero (position 0's
+BOS/pad counts as the first).  Structural differences, both conscious:
+
+* the reference runs a host-driven Python loop of FULL forwards (O(L) model
+  applies over the whole padded sequence); this is a single jitted scan of
+  cached single-token steps — same trajectory semantics, O(L·window)
+  attention instead of O(L²·window);
+* the reference zeroes non-top-k logits and multiplies the gumbel noise by
+  the mask (``utils.py:97-100,121-123``), which can leak a masked token
+  when every top-k entry is negative; here masked entries are ``-inf``
+  (standard top-k gumbel-max).  Temperature generalizes the reference's
+  implicit temperature=1 (pass ``temperature=0`` for greedy).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
+from progen_tpu.models.progen import ProGenConfig
+
+
+def gumbel_topk_sample(key, logits, top_k: int | None, temperature: float = 1.0):
+    """Sample token ids ``(B,)`` from logits ``(B, V)``."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    noise = jax.random.gumbel(key, logits.shape, dtype=logits.dtype)
+    return jnp.argmax(logits + noise, axis=-1)
+
+
+def truncate_after_eos(seq, pad_id: int = 0):
+    """Zero everything after the SECOND zero (reference ``utils.py:131-134``:
+    the BOS/pad at position 0 is the first; the next zero is the learned
+    EOS, which is kept)."""
+    after = jnp.cumsum(seq == pad_id, axis=-1) > 1
+    return seq * (~after)
+
+
+def make_sampler(config: ProGenConfig, policy: Policy | None = None):
+    """Build ``sample(params, key, prime, length, ...)``.
+
+    ``prime``: ``(B, P)`` int tokens (already encoded).  ``length`` must be
+    ≤ ``config.seq_len`` (the gMLP caches are seq_len-sized).  Returns
+    ``(B, length)`` sequences, EOS-truncated.
+    """
+    policy = policy or make_policy()
+    step_model = ProGenDecodeStep(config=config, policy=policy)
+
+    @partial(jax.jit, static_argnames=("length", "top_k", "add_bos", "temperature"))
+    def sample(params, key, prime, length, top_k=None, add_bos=False,
+               temperature=1.0):
+        if prime.ndim != 2:
+            raise ValueError(f"prime must be (B, P), got {prime.shape}")
+        b, p = prime.shape
+        if add_bos:
+            prime = jnp.concatenate(
+                [jnp.zeros((b, 1), prime.dtype), prime[:, : length - 1]], axis=1
+            )
+            p = min(p + 1, length)
+        start_pos = p
+        if not (0 < start_pos <= length <= config.seq_len):
+            raise ValueError(
+                f"need 0 < prime length {start_pos} <= length {length} <= "
+                f"seq_len {config.seq_len}"
+            )
+
+        seq = jnp.zeros((b, length), jnp.int32)
+        seq = jax.lax.dynamic_update_slice(seq, prime.astype(jnp.int32), (0, 0))
+        caches = init_caches(config, b, policy)
+
+        def body(carry, pos):
+            seq, caches, key = carry
+            tok = jax.lax.dynamic_index_in_dim(seq, pos, axis=1, keepdims=False)
+            logits, caches = step_model.apply(params, tok, pos, caches)
+            key, sub = jax.random.split(key)
+            nxt = gumbel_topk_sample(sub, logits.astype(jnp.float32), top_k,
+                                     temperature).astype(jnp.int32)
+            write = (pos + 1 >= start_pos) & (pos + 1 < length)
+            cur = jax.lax.dynamic_index_in_dim(seq, jnp.minimum(pos + 1, length - 1),
+                                               axis=1, keepdims=False)
+            val = jnp.where(write, nxt, cur)
+            seq = jax.lax.dynamic_update_index_in_dim(
+                seq, val, jnp.minimum(pos + 1, length - 1), axis=1
+            )
+            return (seq, caches, key), None
+
+        (seq, _, _), _ = jax.lax.scan(
+            body, (seq, caches, key), jnp.arange(length)
+        )
+        return truncate_after_eos(seq)
+
+    return sample
+
+
+def teacher_forced_logits(config: ProGenConfig, params, tokens,
+                          policy: Policy | None = None):
+    """Run the cached decode step over a FIXED token sequence and return all
+    logits ``(B, L, V)`` — the decode-vs-parallel parity oracle (tests) and
+    a scoring utility."""
+    policy = policy or make_policy()
+    step_model = ProGenDecodeStep(config=config, policy=policy)
+    b, n = tokens.shape
+    caches = init_caches(config, b, policy)
+
+    def body(caches, pos):
+        tok = jax.lax.dynamic_index_in_dim(tokens, pos, axis=1, keepdims=False)
+        logits, caches = step_model.apply(params, tok, pos, caches)
+        return caches, logits
+
+    _, logits = jax.lax.scan(body, caches, jnp.arange(n))
+    return jnp.transpose(logits, (1, 0, 2))

@@ -1,0 +1,110 @@
+"""Data pipeline tests: tokenizer, tfrecord round-trip, collate, skip-resume,
+multi-host sharding arithmetic."""
+
+import numpy as np
+import pytest
+
+from progen_tpu.data import (
+    collate,
+    count_sequences,
+    decode_tokens,
+    encode_tokens,
+    iterator_from_tfrecords_folder,
+    parse_shard_filename,
+    shard_filename,
+    write_tfrecord,
+)
+
+
+def test_tokenizer_roundtrip():
+    s = "MSKGEELFTG# [tax=Homo]"
+    toks = encode_tokens(s)
+    assert min(toks) >= 1  # id 0 reserved
+    assert decode_tokens(np.asarray(toks)) == s
+
+
+def test_decode_drops_pad():
+    assert decode_tokens(np.asarray([0, 66, 0, 67, 0])) == "AB"
+
+
+def test_shard_filename_protocol():
+    name = shard_filename(3, 127, "train")
+    assert name == "3.127.train.tfrecord.gz"
+    assert parse_shard_filename(name) == 127
+    assert parse_shard_filename("/some/dir/0.50.valid.tfrecord.gz") == 50
+
+
+def test_collate_contract():
+    seqs = [b"ABC", b"ABCDEFGHIJ"]
+    out = collate(seqs, seq_len=5)
+    assert out.shape == (2, 6) and out.dtype == np.int32
+    # BOS column, +1 offset, right-pad
+    np.testing.assert_array_equal(out[0], [0, 66, 67, 68, 0, 0])
+    # truncation to seq_len
+    np.testing.assert_array_equal(out[1], [0, 66, 67, 68, 69, 70])
+
+
+@pytest.fixture()
+def tfrecord_dir(tmp_path):
+    seqs = [f"SEQ{i:03d}PROTEIN".encode() for i in range(20)]
+    n1 = write_tfrecord(tmp_path / shard_filename(0, 12, "train"), seqs[:12])
+    n2 = write_tfrecord(tmp_path / shard_filename(1, 8, "train"), seqs[12:])
+    write_tfrecord(tmp_path / shard_filename(0, 4, "valid"),
+                   [b"VALSEQ%d" % i for i in range(4)])
+    assert (n1, n2) == (12, 8)
+    return tmp_path
+
+
+def test_roundtrip_and_counts(tfrecord_dir):
+    num, it_fn = iterator_from_tfrecords_folder(str(tfrecord_dir), "train")
+    assert num == 20
+    assert count_sequences(str(tfrecord_dir), "valid") == 4
+    batches = list(it_fn(seq_len=16, batch_size=8))
+    assert [b.shape for b in batches] == [(8, 17), (8, 17), (4, 17)]
+    got = decode_tokens(batches[0][0])
+    assert got == "SEQ000PROTEIN"
+
+
+def test_skip_resume_is_record_exact(tfrecord_dir):
+    _, it_fn = iterator_from_tfrecords_folder(str(tfrecord_dir), "train")
+    full = np.concatenate(list(it_fn(seq_len=16, batch_size=4)))
+    resumed = np.concatenate(list(it_fn(seq_len=16, batch_size=4, skip=6)))
+    np.testing.assert_array_equal(resumed, full[6:])
+    # resume correctness across batch-size change (README.md:112 claim)
+    resumed2 = np.concatenate(list(it_fn(seq_len=16, batch_size=7, skip=6)))
+    np.testing.assert_array_equal(resumed2, full[6:])
+
+
+def test_multihost_sharding_partitions_records(tfrecord_dir):
+    _, it_fn = iterator_from_tfrecords_folder(str(tfrecord_dir), "train")
+    full = np.concatenate(list(it_fn(seq_len=16, batch_size=4)))
+    shards = [
+        np.concatenate(list(it_fn(seq_len=16, batch_size=2,
+                                  process_count=2, process_index=i)))
+        for i in range(2)
+    ]
+    assert sum(s.shape[0] for s in shards) == full.shape[0]
+    # disjoint and complete: every record appears exactly once across hosts
+    all_rows = np.concatenate(shards)
+    assert {decode_tokens(r) for r in all_rows} == {decode_tokens(r) for r in full}
+    # per-host skip: global skip 4 -> each host skips 2 of its own stream
+    s0 = np.concatenate(list(it_fn(seq_len=16, batch_size=2,
+                                   process_count=2, process_index=0, skip=4)))
+    np.testing.assert_array_equal(s0, shards[0][2:])
+
+
+def test_skip_must_divide_by_process_count(tfrecord_dir):
+    _, it_fn = iterator_from_tfrecords_folder(str(tfrecord_dir), "train")
+    with pytest.raises(ValueError):
+        next(it_fn(seq_len=16, batch_size=2, process_count=2, skip=3))
+
+
+def test_loop_repeats(tfrecord_dir):
+    _, it_fn = iterator_from_tfrecords_folder(str(tfrecord_dir), "train")
+    it = it_fn(seq_len=16, batch_size=16, loop=True)
+    seen = 0
+    for batch in it:
+        seen += batch.shape[0]
+        if seen > 40:  # corpus is 20; looping proven
+            break
+    assert seen > 40
