@@ -1,3 +1,7 @@
-from progen_tpu.checkpoint.store import CheckpointStore, abstract_state_like
+from progen_tpu.checkpoint.store import (
+    CheckpointStore,
+    abstract_params_like,
+    abstract_state_like,
+)
 
-__all__ = ["CheckpointStore", "abstract_state_like"]
+__all__ = ["CheckpointStore", "abstract_params_like", "abstract_state_like"]

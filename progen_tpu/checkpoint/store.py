@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 from etils import epath
 
@@ -68,16 +69,22 @@ class CheckpointStore:
         model_config: dict,
         run_id: str | None = None,
     ) -> None:
+        """``state`` is a TrainState; params and opt_state are stored as
+        SEPARATE items so inference can restore params without knowing the
+        optimizer structure (the reference's single pickle forces sample.py
+        to deserialize optimizer moments it never uses)."""
         meta = {
             "next_seq_index": int(next_seq_index),
             "model_config": model_config,
             "run_id": run_id,
+            "train_step": int(state.step),
         }
         mgr = self._manager()
         mgr.save(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
+                params=ocp.args.StandardSave(state.params),
+                opt_state=ocp.args.StandardSave(state.opt_state),
                 meta=ocp.args.JsonSave(meta),
             ),
         )
@@ -93,12 +100,12 @@ class CheckpointStore:
         out = mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
         return dict(out["meta"])
 
-    def restore_state(self, abstract_state: Any, step: int | None = None):
-        """Restore the train state.
+    def restore_params(self, abstract_params: Any, step: int | None = None):
+        """Params only — enough for inference/sampling.
 
-        ``abstract_state`` is a pytree of ``jax.ShapeDtypeStruct`` (with
-        ``sharding`` set for a sharded restore) matching what was saved —
-        build it with ``jax.eval_shape`` over the state factory.
+        ``abstract_params`` is a pytree of ``jax.ShapeDtypeStruct`` (with
+        ``sharding`` set for a sharded restore); build it with
+        ``jax.eval_shape``.
         """
         mgr = self._manager()
         step = step if step is not None else mgr.latest_step()
@@ -106,14 +113,55 @@ class CheckpointStore:
             return None
         out = mgr.restore(
             step,
-            args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract_state)),
+            args=ocp.args.Composite(params=ocp.args.StandardRestore(abstract_params)),
         )
-        return out["state"]
+        return out["params"]
+
+    def restore_state(self, abstract_state: Any, step: int | None = None):
+        """Full train state (params + optimizer moments + step counter).
+
+        ``abstract_state`` is an abstract TrainState pytree — see
+        :func:`abstract_state_like`.
+        """
+        mgr = self._manager()
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            return None
+        out = mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(abstract_state.params),
+                opt_state=ocp.args.StandardRestore(abstract_state.opt_state),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        return type(abstract_state)(
+            step=jnp.asarray(out["meta"]["train_step"], jnp.int32),
+            params=out["params"],
+            opt_state=out["opt_state"],
+        )
 
     def close(self) -> None:
         if self._mgr is not None:
             self._mgr.close()
             self._mgr = None
+
+
+def abstract_params_like(model, sample_tokens, shardings=None):
+    """Abstract params pytree for :meth:`CheckpointStore.restore_params`."""
+    from progen_tpu.parallel.sharding import unbox
+
+    abstract = jax.eval_shape(
+        lambda k: unbox(model.init(k, sample_tokens))["params"],
+        jax.random.key(0),
+    )
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            abstract,
+            shardings,
+        )
+    return abstract
 
 
 def abstract_state_like(fns, key=None):

@@ -1,0 +1,4 @@
+from progen_tpu.observe.meter import ThroughputMeter, profile_trace
+from progen_tpu.observe.tracker import Tracker
+
+__all__ = ["ThroughputMeter", "profile_trace", "Tracker"]

@@ -1,0 +1,62 @@
+"""Throughput metering + profiler hooks.
+
+The reference has no profiling or throughput reporting (SURVEY.md §5.1);
+BASELINE.md's metric is Uniref50 tokens/sec/chip, so the meter is a
+first-class subsystem here.  ``jax.profiler`` traces can be toggled around
+any step window for xprof/tensorboard analysis.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class ThroughputMeter:
+    """Tokens/sec (global and per-chip) over a sliding window of steps.
+
+    Call ``tick(tokens)`` once per optimizer step AFTER the step's result is
+    known to be materialized (the trainer blocks on the loss periodically —
+    async dispatch otherwise makes per-step walltime meaningless).
+    """
+
+    def __init__(self, window: int = 50):
+        self._window = window
+        self._times: list[float] = []
+        self._tokens: list[int] = []
+
+    def tick(self, tokens: int) -> None:
+        self._times.append(time.perf_counter())
+        self._tokens.append(tokens)
+        if len(self._times) > self._window + 1:
+            self._times.pop(0)
+            self._tokens.pop(0)
+
+    @property
+    def tokens_per_sec(self) -> float | None:
+        if len(self._times) < 2:
+            return None
+        dt = self._times[-1] - self._times[0]
+        toks = sum(self._tokens[1:])  # tokens of steps 1..n (intervals)
+        return toks / dt if dt > 0 else None
+
+    @property
+    def tokens_per_sec_per_chip(self) -> float | None:
+        tps = self.tokens_per_sec
+        return None if tps is None else tps / jax.device_count()
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str | None):
+    """``with profile_trace('/tmp/trace'):`` records an xprof trace of the
+    enclosed steps; no-op when logdir is None."""
+    if logdir is None:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,78 @@
+"""Sampling CLI — reference ``sample.py`` equivalent
+(``/root/reference/sample.py:23-76``): load the last checkpoint, rebuild the
+model from its stored config, decode with a prime, print.  Decoding runs
+the cached scan sampler instead of O(L) full forwards.
+"""
+
+import os
+
+import click
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+@click.command()
+@click.option("--seed", default=42)
+@click.option("--checkpoint_path", default="./ckpts")
+@click.option("--prime", default="")
+@click.option("--top_k", default=25)
+@click.option("--temperature", default=1.0)
+@click.option("--num_samples", default=1, help="decode N sequences in one batch")
+def main(seed, checkpoint_path, prime, top_k, temperature, num_samples):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from progen_tpu.checkpoint import CheckpointStore, abstract_params_like
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.core.rng import KeySeq
+    from progen_tpu.data import decode_tokens, encode_tokens
+    from progen_tpu.decode import make_sampler
+    from progen_tpu.models import ProGen, ProGenConfig
+
+    store = CheckpointStore(checkpoint_path)
+    meta = store.restore_meta()
+    if meta is None:
+        raise SystemExit(f"no checkpoints found at {checkpoint_path}")
+
+    model_config = ProGenConfig.from_dict(meta["model_config"])
+    policy = make_policy(True)
+    model = ProGen(config=model_config, policy=policy)
+    sample_tokens = jnp.zeros((1, model_config.seq_len), jnp.int32)
+    params = store.restore_params(abstract_params_like(model, sample_tokens))
+    store.close()
+
+    num_params = sum(x.size for x in jax.tree.leaves(params))
+    seq_len = model_config.seq_len
+    print(f"params: {num_params:,}")
+    print(f"sequence length: {seq_len}")
+    print(f"trained for {max(meta['next_seq_index'], 0)} sequences")
+
+    prime_tokens = encode_tokens(prime)
+    prime_length = len(prime_tokens) + 1  # + BOS
+    batch = jnp.tile(jnp.asarray(prime_tokens, jnp.int32)[None, :]
+                     if prime_tokens else jnp.zeros((1, 0), jnp.int32),
+                     (num_samples, 1))
+
+    sampler = make_sampler(model_config, policy)
+    keys = KeySeq(seed)
+    # add_bos handles empty primes too (a lone BOS column primes the model)
+    if batch.shape[1] == 0:
+        batch = jnp.zeros((num_samples, 1), jnp.int32)
+        sampled = sampler({"params": params}, next(keys), batch, length=seq_len,
+                          top_k=top_k, temperature=temperature)
+        prime_length = 1
+    else:
+        sampled = sampler({"params": params}, next(keys), batch, length=seq_len,
+                          top_k=top_k, add_bos=True, temperature=temperature)
+
+    for row in np.asarray(sampled):
+        print("\n", prime, "\n", "*" * 40, "\n",
+              decode_tokens(row[prime_length:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Training CLI — flag-compatible with the reference ``train.py``
+(``/root/reference/train.py:36-58``), plus TPU-native flags for mesh shape,
+sharding strategies, rematerialization and profiling.
+
+Multi-host: run the same command on every host with
+``jax.distributed`` env vars set (or pass --distributed to autodetect).
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import click
+
+# this image's jax build hardwires its default platform list and ignores
+# JAX_PLATFORMS from the environment; honor it explicitly so CPU runs and
+# tests behave as users expect
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+# keep stdlib tomllib (py3.11+); the reference used the third-party `toml`
+import tomllib
+
+
+def _load_model_config(config_path: str, model_name: str) -> dict:
+    path = Path(config_path) / f"{model_name}.toml"
+    assert path.exists(), f"path to your model config {path} does not exist"
+    return tomllib.loads(path.read_text())
+
+
+@click.command()
+@click.option("--seed", default=42)
+@click.option("--batch_size", default=4)
+@click.option("--grad_accum_every", default=4)
+@click.option("--epochs", default=100)
+@click.option("--learning_rate", default=2e-4)
+@click.option("--weight_decay", default=1e-3)
+@click.option("--max_grad_norm", default=0.5)
+@click.option("--validate_every", default=100)
+@click.option("--sample_every", default=500)
+@click.option("--checkpoint_every", default=1000)
+@click.option("--checkpoint_path", default="./ckpts")
+@click.option("--checkpoint_keep_n", default=500)
+@click.option("--config_path", default="./configs/model")
+@click.option("--model_name", default="default")
+@click.option("--prime_length", default=25)
+@click.option("--mixed_precision", default=False, is_flag=True)
+@click.option("--data_path", default="./train_data")
+@click.option("--wandb_off", default=False, is_flag=True)
+@click.option("--wandb_project_name", default="progen-training")
+@click.option("--new", default=False, is_flag=True)
+# TPU-native flags (no reference counterpart)
+@click.option("--strategies", default="dp",
+              help="comma list of sharding strategies: dp,fsdp,tp,sp")
+@click.option("--mesh", "mesh_spec", default="-1,1,1,1",
+              help="mesh axis sizes data,fsdp,tensor,seq (-1 = remaining)")
+@click.option("--remat", default=False, is_flag=True,
+              help="rematerialize blocks in backward (saves HBM)")
+@click.option("--log_every", default=10)
+@click.option("--max_steps", default=None, type=int)
+@click.option("--profile_dir", default=None, type=str)
+@click.option("--runs_dir", default="./runs")
+@click.option("--distributed", default=False, is_flag=True,
+              help="call jax.distributed.initialize() for multi-host")
+# accepted for reference compatibility; the pmap flag is meaningless under
+# pjit — dp over the mesh is the default
+@click.option("--data_parallel", default=False, is_flag=True, hidden=True)
+@click.option("--seq_len", default=None, type=int, hidden=True)
+def main(**flags):
+    if flags["distributed"]:
+        from progen_tpu.core.mesh import initialize_distributed
+
+        initialize_distributed()
+
+    from progen_tpu.checkpoint import CheckpointStore
+    from progen_tpu.core.mesh import MeshConfig
+    from progen_tpu.models import ProGenConfig
+    from progen_tpu.observe import Tracker
+    from progen_tpu.train.trainer import Trainer, TrainerConfig
+
+    store = CheckpointStore(flags["checkpoint_path"], flags["checkpoint_keep_n"])
+    if flags["new"]:
+        if not click.confirm(
+            "are you sure you want to clear all your checkpoints and restart "
+            "training?"
+        ):
+            sys.exit()
+        store.reset()
+
+    # model config: checkpoint wins on resume (reference train.py:96-102)
+    meta = store.restore_meta()
+    if meta is None:
+        model_kwargs = _load_model_config(flags["config_path"],
+                                          flags["model_name"])
+    else:
+        model_kwargs = meta["model_config"]
+    store.close()
+    model_config = ProGenConfig.from_dict(model_kwargs)
+
+    axes = [int(x) for x in flags["mesh_spec"].split(",")]
+    mesh_cfg = MeshConfig(data=axes[0], fsdp=axes[1], tensor=axes[2],
+                          seq=axes[3])
+
+    cfg = TrainerConfig(
+        seed=flags["seed"],
+        batch_size=flags["batch_size"],
+        grad_accum_every=flags["grad_accum_every"],
+        epochs=flags["epochs"],
+        learning_rate=flags["learning_rate"],
+        weight_decay=flags["weight_decay"],
+        max_grad_norm=flags["max_grad_norm"],
+        validate_every=flags["validate_every"],
+        sample_every=flags["sample_every"],
+        checkpoint_every=flags["checkpoint_every"],
+        checkpoint_keep_n=flags["checkpoint_keep_n"],
+        prime_length=flags["prime_length"],
+        mixed_precision=flags["mixed_precision"],
+        strategies=tuple(flags["strategies"].split(",")),
+        mesh=mesh_cfg,
+        remat=flags["remat"],
+        log_every=flags["log_every"],
+        max_steps=flags["max_steps"],
+        profile_dir=flags["profile_dir"],
+    )
+
+    tracker = Tracker(
+        project=flags["wandb_project_name"],
+        out_dir=flags["runs_dir"],
+        run_id=(meta or {}).get("run_id"),
+        use_wandb=not flags["wandb_off"],  # JSONL sink is always on
+        config={**model_kwargs, **{k: v for k, v in flags.items()
+                                   if k not in ("new",)}},
+    )
+
+    trainer = Trainer(
+        model_config=model_config,
+        cfg=cfg,
+        data_path=flags["data_path"],
+        checkpoint_path=flags["checkpoint_path"],
+        tracker=tracker,
+    )
+    try:
+        trainer.run()
+    finally:
+        tracker.finish()
+
+
+if __name__ == "__main__":
+    main()
