@@ -1,0 +1,102 @@
+"""Benchmark: training-step throughput, tokens/sec/chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}``
+
+The metric matches BASELINE.md: Uniref50-shaped training throughput
+(ProGen-small class model, seq_len 1024, bf16 compute).  ``vs_baseline``
+is measured against the driver BASELINE.json north star of 40k
+tokens/sec/chip (at 1.2B on v4-32); >1.0 beats it.
+
+Env overrides: PROGEN_BENCH_CONFIG (default "small"),
+PROGEN_BENCH_BATCH (default 8), PROGEN_BENCH_STEPS (default 10).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NORTH_STAR_TOKENS_PER_SEC_PER_CHIP = 40_000.0
+
+
+def synthetic_uniref_batch(rng: np.random.Generator, batch: int, seq_len: int):
+    """Uniref50-shaped rows: '# ' + uppercase residues, +1 offset, BOS col,
+    pad tail — same layout the tfrecord collate emits."""
+    out = np.zeros((batch, seq_len + 1), dtype=np.int32)
+    for i in range(batch):
+        n = int(rng.integers(seq_len // 2, seq_len + 1))
+        residues = rng.integers(ord("A"), ord("Z") + 1, size=n - 2)
+        row = np.concatenate(([ord("#"), ord(" ")], residues)) + 1
+        out[i, 1 : 1 + n] = row
+    return out
+
+
+def main() -> None:
+    from progen_tpu.core.mesh import MeshConfig, make_mesh
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.models import ProGen
+    from progen_tpu.models.configs import CONFIGS
+    from progen_tpu.train import make_optimizer, make_train_functions
+
+    config_name = os.environ.get("PROGEN_BENCH_CONFIG", "small")
+    batch = int(os.environ.get("PROGEN_BENCH_BATCH", "8"))
+    steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
+    warmup = 3
+
+    cfg = CONFIGS[config_name]
+    n_chips = jax.device_count()
+    mesh = make_mesh(MeshConfig()) if n_chips > 1 else None
+
+    model = ProGen(config=cfg, policy=make_policy(mixed_precision=True))
+    sample = jnp.zeros((batch, cfg.seq_len), jnp.int32)
+    fns = make_train_functions(
+        model, make_optimizer(2e-4), sample,
+        mesh=mesh, strategies=("dp",),
+    )
+    state = fns.init_state(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    batches = [
+        jnp.asarray(synthetic_uniref_batch(rng, batch, cfg.seq_len))
+        for _ in range(4)
+    ]
+
+    for i in range(warmup):
+        state, metrics = fns.train_step(state, batches[i % len(batches)])
+    float(metrics["loss"])  # host transfer: the only reliable full sync on
+    # tunneled backends where block_until_ready can return early
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = fns.train_step(state, batches[i % len(batches)])
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = steps * batch * cfg.seq_len
+    tps_chip = tokens / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"uniref50-shaped train throughput, ProGen-{config_name} "
+                    f"(seq_len {cfg.seq_len}, batch {batch}, bf16, "
+                    f"{n_chips} chip(s))"
+                ),
+                "value": round(tps_chip, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(
+                    tps_chip / NORTH_STAR_TOKENS_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

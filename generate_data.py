@@ -1,0 +1,45 @@
+"""Data-prep CLI — reference ``generate_data.py`` equivalent: TOML-config
+FASTA -> sharded GZIP tfrecords (+optional GCS), without the Prefect DAG.
+"""
+
+import os
+
+import click
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import tomllib
+from pathlib import Path
+
+
+@click.command()
+@click.option("--data_dir", default="./configs/data")
+@click.option("--name", default="default")
+@click.option("--seed", default=0)
+def main(data_dir, name, seed):
+    config_path = Path(data_dir) / f"{name}.toml"
+    assert config_path.exists(), f"config does not exist at {config_path}"
+    config = tomllib.loads(config_path.read_text())
+
+    from progen_tpu.data.fasta import generate_tfrecords
+
+    counts = generate_tfrecords(
+        read_from=config["read_from"],
+        write_to=config["write_to"],
+        max_seq_len=config.get("max_seq_len", 1024),
+        num_samples=config.get("num_samples"),
+        fraction_valid_data=config.get("fraction_valid_data", 0.025),
+        num_sequences_per_file=config.get("num_sequences_per_file", 1000),
+        prob_invert_seq_annotation=config.get("prob_invert_seq_annotation", 0.5),
+        sort_annotations=config.get("sort_annotations", True),
+        seed=seed,
+    )
+    print(f"wrote {counts['train']} train / {counts['valid']} valid sequences "
+          f"to {config['write_to']}")
+
+
+if __name__ == "__main__":
+    main()

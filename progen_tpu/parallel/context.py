@@ -1,0 +1,118 @@
+"""Context (sequence) parallelism: explicit halo exchange over the mesh's
+``seq`` axis.
+
+The model's two sequence-mixing structures (SURVEY.md §5.7) and their CP
+communication patterns:
+
+* **Local windowed attention** (``ops/local_attention.py``): each query
+  window needs only ``[previous window ‖ own window]`` keys, so a sequence
+  shard needs exactly ONE window of halo from its left neighbour — a
+  single ``ppermute`` hop per layer, O(B·H·window·D) bytes over ICI,
+  instead of the generic all-to-all GSPMD falls back to.  Device 0's
+  missing left neighbour is the reference's phantom zero-pad window
+  (``progen.py:90-95``), which ``ppermute`` provides for free: slots with
+  no source are filled with zeros.
+* **SGU/gMLP spatial matmul** (``ops/sgu.py``): output row m mixes ALL
+  gate rows n <= m, so the gate tensor is all-gathered along ``seq``
+  (O(B·L·D/shards) per device per layer — the standard sequence-parallel
+  dense-mixing cost) while the learned ``(L, L)`` weights stay row-sharded;
+  causal masking uses GLOBAL row indices derived from the shard index.
+
+Both functions are drop-in equivalents of their single-device ops — the
+tests assert exact agreement — and are used via ``shard_map`` so the
+collectives are explicit and XLA schedules them against compute.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from progen_tpu.ops.local_attention import local_attention
+from progen_tpu.ops.sgu import spatial_gate
+
+
+def _left_halo(t, axis_name: str):
+    """Send each shard's LAST window right; receive the left neighbour's
+    (zeros at the leftmost shard).  ``t``: (..., W_local, wsz, D) ->
+    (..., 1, wsz, D) halo window."""
+    n = jax.lax.axis_size(axis_name)
+    last = t[..., -1:, :, :]
+    if n == 1:
+        return jnp.zeros_like(last)
+    return jax.lax.ppermute(
+        last, axis_name, perm=[(i, i + 1) for i in range(n - 1)]
+    )
+
+
+def cp_local_attention(
+    q, k, v, *, mesh: Mesh, window_size: int, scale: float | None = None,
+    seq_axis: str = "seq",
+):
+    """Sequence-sharded windowed attention: ``(B, H, L, D)`` global tensors,
+    L sharded over ``mesh[seq_axis]``; one ppermute halo per call.
+
+    Requires ``L_local % window_size == 0`` (shard boundaries align to
+    windows — the natural layout for this model).
+    """
+
+    def inner(q_loc, k_loc, v_loc):
+        b, h, n_loc, d = q_loc.shape
+        wsz = window_size
+        if n_loc % wsz != 0:
+            raise ValueError(
+                f"local sequence {n_loc} must be divisible by window {wsz}; "
+                "choose a seq-axis size that keeps whole windows per shard"
+            )
+        w_loc = n_loc // wsz
+        kw = k_loc.reshape(b, h, w_loc, wsz, d)
+        vw = v_loc.reshape(b, h, w_loc, wsz, d)
+
+        k_halo = _left_halo(kw, seq_axis)
+        v_halo = _left_halo(vw, seq_axis)
+        # previous window of window j: [halo, own windows 0..W-2][j]
+        k_prev = jnp.concatenate([k_halo, kw[..., :-1, :, :]], axis=-3)
+        v_prev = jnp.concatenate([v_halo, vw[..., :-1, :, :]], axis=-3)
+        k2 = jnp.concatenate([k_prev, kw], axis=-2)  # (b,h,W,2wsz,d)
+        v2 = jnp.concatenate([v_prev, vw], axis=-2)
+
+        return local_attention(q_loc, k2, v2, window_size=wsz, scale=scale)
+
+    spec = P(None, None, seq_axis, None)
+    return shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )(q, k, v)
+
+
+def cp_spatial_gate(
+    gate, weights, biases, *, mesh: Mesh, seq_axis: str = "seq"
+):
+    """Sequence-sharded SGU mixing: ``gate (B, L, D)`` sharded on L,
+    ``weights (L, L)``/``biases (L, 1)`` row-sharded; all-gather the gate,
+    keep rows local, mask causally by GLOBAL row index."""
+    n_total = weights.shape[0]
+
+    def inner(gate_loc, w_loc, b_loc):
+        n_loc = w_loc.shape[0]
+        idx = jax.lax.axis_index(seq_axis)
+        # gather full gate along the sequence: (B, L, D)
+        gate_full = jax.lax.all_gather(gate_loc, seq_axis, axis=1, tiled=True)
+        rows = idx * n_loc + jnp.arange(n_loc)          # global row ids
+        mask = (jnp.arange(n_total)[None, :] <= rows[:, None]).astype(w_loc.dtype)
+        w = w_loc * mask
+        mixed = jnp.einsum("bnd,mn->bmd", gate_full, w,
+                           preferred_element_type=jnp.float32)
+        return (mixed + b_loc).astype(gate_loc.dtype)
+
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(None, seq_axis, None), P(seq_axis, None), P(seq_axis, None)),
+        out_specs=P(None, seq_axis, None),
+        check_rep=False,
+    )(gate, weights, biases)

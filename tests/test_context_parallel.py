@@ -1,0 +1,80 @@
+"""Context-parallel equivalence: the shard_map halo-exchange attention and
+the sequence-sharded SGU must agree with the single-device ops exactly
+(SURVEY.md §7 hard part #3: halo correctness at shard edges)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.core import MeshConfig, make_mesh
+from progen_tpu.ops import local_attention, spatial_gate
+from progen_tpu.parallel.context import cp_local_attention, cp_spatial_gate
+
+
+@pytest.fixture(scope="module")
+def seq_mesh(devices8):
+    return make_mesh(MeshConfig(data=1, fsdp=1, tensor=1, seq=4),
+                     devices=devices8[:4])
+
+
+@pytest.mark.parametrize("n,wsz", [(32, 8), (64, 8), (32, 4)])
+def test_cp_attention_matches_single_device(seq_mesh, n, wsz):
+    rng = np.random.default_rng(0)
+    b, h, d = 2, 3, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, n, d)), jnp.float32)
+               for _ in range(3))
+    want = local_attention(q, k, v, window_size=wsz)
+    got = cp_local_attention(q, k, v, mesh=seq_mesh, window_size=wsz)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cp_attention_shard_boundaries_are_window_boundaries(seq_mesh):
+    """L=32 over 4 shards -> 8 per shard; with window 8 each shard holds
+    exactly one window, so EVERY previous-window lookup crosses a shard
+    edge — the pure-halo regime."""
+    rng = np.random.default_rng(1)
+    b, h, n, d, wsz = 1, 2, 32, 4, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, n, d)), jnp.float32)
+               for _ in range(3))
+    want = local_attention(q, k, v, window_size=wsz)
+    got = cp_local_attention(q, k, v, mesh=seq_mesh, window_size=wsz)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cp_attention_rejects_partial_windows(seq_mesh):
+    q = jnp.zeros((1, 1, 24, 4))  # 24/4 shards = 6 per shard, window 4: 6%4!=0
+    with pytest.raises(ValueError, match="divisible by window"):
+        cp_local_attention(q, q, q, mesh=seq_mesh, window_size=4)
+
+
+@pytest.mark.parametrize("n", [16, 32])
+def test_cp_spatial_gate_matches_single_device(seq_mesh, n):
+    rng = np.random.default_rng(2)
+    b, d = 2, 6
+    gate = jnp.asarray(rng.normal(size=(b, n, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(n, n)), jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(n, 1)), jnp.float32)
+    want = spatial_gate(gate, w, bias)
+    got = cp_spatial_gate(gate, w, bias, mesh=seq_mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cp_gradients_flow(seq_mesh):
+    """Backward through the shard_map path must work and match."""
+    rng = np.random.default_rng(3)
+    b, h, n, d, wsz = 1, 2, 32, 4, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, n, d)), jnp.float32)
+               for _ in range(3))
+
+    f_plain = lambda q, k, v: local_attention(q, k, v, window_size=wsz).sum()
+    f_cp = lambda q, k, v: cp_local_attention(
+        q, k, v, mesh=seq_mesh, window_size=wsz).sum()
+    g_plain = jax.grad(f_plain, argnums=(0, 1, 2))(q, k, v)
+    g_cp = jax.grad(f_cp, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_plain, g_cp):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
+                                   rtol=1e-5, atol=1e-5)

@@ -1,0 +1,103 @@
+"""FASTA prep tests: parser, annotation extraction, '#' convention,
+full round-trip fasta -> tfrecords -> iterator."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from progen_tpu.data import decode_tokens, iterator_from_tfrecords_folder
+from progen_tpu.data.fasta import (
+    annotations_from_description,
+    generate_tfrecords,
+    parse_fasta,
+    sequence_strings,
+)
+
+FASTA = """>UniRef50_A0A009 Uncharacterized protein n=1 Tax=Acinetobacter TaxID=52
+MSKGEELFTGVVPILVELDGDVNG
+HKFSVSGEGEG
+>UniRef50_B0B010 Another protein n=2 RepID=X
+MKLVINLILAC
+>UniRef50_C0C011 Long one n=3 Tax=Homo sapiens TaxID=9606
+MSKGEELFTGVVPILVELDGDVNGHKFSVSGEGEGDATYGKLTLKFICTT
+"""
+
+
+@pytest.fixture()
+def fasta_path(tmp_path):
+    p = tmp_path / "test.fasta"
+    p.write_text(FASTA)
+    return p
+
+
+def test_parse_fasta(fasta_path):
+    records = list(parse_fasta(str(fasta_path)))
+    assert len(records) == 3
+    desc, seq = records[0]
+    assert desc.startswith("UniRef50_A0A009")
+    assert seq == "MSKGEELFTGVVPILVELDGDVNGHKFSVSGEGEG"  # multi-line joined
+
+
+def test_parse_fasta_gz(tmp_path):
+    p = tmp_path / "test.fasta.gz"
+    with gzip.open(p, "wt") as f:
+        f.write(FASTA)
+    assert len(list(parse_fasta(str(p)))) == 3
+
+
+def test_annotation_regex():
+    assert annotations_from_description(
+        "Uncharacterized protein n=1 Tax=Acinetobacter TaxID=52"
+    ) == {"tax": "Acinetobacter"}
+    assert annotations_from_description("no tax here RepID=X") == {}
+    assert annotations_from_description(
+        "x Tax=Homo sapiens TaxID=9606"
+    ) == {"tax": "Homo sapiens"}
+
+
+def test_sequence_strings_conventions():
+    rng = np.random.default_rng(0)
+    # no annotation -> exactly one plain "# SEQ" string
+    out = sequence_strings("plain protein", "MKLV", rng, prob_invert=0.0)
+    assert out == [b"# MKLV"]
+    # annotation -> annotated string first, plain string always present
+    out = sequence_strings("x Tax=Homo TaxID=1", "MKLV", rng, prob_invert=0.0)
+    assert out[1] == b"# MKLV"
+    assert out[0].startswith(b"[tax=") and b" # MKLV" in out[0]
+    # prob_invert=1 -> sequence first, annotation last
+    out = sequence_strings("x Tax=Homo TaxID=1", "MKLV", rng, prob_invert=1.0)
+    assert out[0].startswith(b"MKLV # [tax=")
+
+
+def test_generate_tfrecords_roundtrip(fasta_path, tmp_path):
+    out_dir = tmp_path / "records"
+    counts = generate_tfrecords(
+        str(fasta_path), str(out_dir),
+        max_seq_len=40,          # filters out the 50-char record
+        fraction_valid_data=0.25,
+        num_sequences_per_file=2,
+        seed=0,
+    )
+    # 2 records pass the filter; record 1 has Tax -> 2 strings, record 2 -> 1
+    assert counts["train"] + counts["valid"] == 3
+    assert counts["valid"] == 1  # ceil(0.25 * 3)
+
+    n_train, it_fn = iterator_from_tfrecords_folder(str(out_dir), "train")
+    assert n_train == counts["train"]
+    rows = np.concatenate(list(it_fn(seq_len=40, batch_size=4)))
+    texts = [decode_tokens(r) for r in rows]
+    assert all("#" in t for t in texts)
+
+
+def test_generate_is_deterministic(fasta_path, tmp_path):
+    a = generate_tfrecords(str(fasta_path), str(tmp_path / "a"), seed=7,
+                           fraction_valid_data=0.0)
+    b = generate_tfrecords(str(fasta_path), str(tmp_path / "b"), seed=7,
+                           fraction_valid_data=0.0)
+    assert a == b
+    _, it_a = iterator_from_tfrecords_folder(str(tmp_path / "a"), "train")
+    _, it_b = iterator_from_tfrecords_folder(str(tmp_path / "b"), "train")
+    ra = np.concatenate(list(it_a(seq_len=64, batch_size=8)))
+    rb = np.concatenate(list(it_b(seq_len=64, batch_size=8)))
+    np.testing.assert_array_equal(ra, rb)
