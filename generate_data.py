@@ -2,14 +2,7 @@
 FASTA -> sharded GZIP tfrecords (+optional GCS), without the Prefect DAG.
 """
 
-import os
-
 import click
-
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import tomllib
 from pathlib import Path

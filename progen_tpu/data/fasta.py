@@ -107,23 +107,48 @@ def generate_tfrecords(
     """Run the full prep: returns ``{"train": n, "valid": m}`` counts."""
     rng = np.random.default_rng(seed)
 
-    strings: list[bytes] = []
+    # Spool encoded strings to one on-disk file, keeping only (offset, len)
+    # per string in RAM — full-corpus Uniref50 emits tens of GB of strings,
+    # which must not be held in memory (the reference staged one tmp gzip
+    # file PER STRING, generate_data.py:76-79; one spool file is kinder to
+    # the filesystem).
+    import tempfile
+
+    offsets: list[int] = []
+    lengths: list[int] = []
     taken = 0
-    for desc, seq in parse_fasta(read_from):
-        if len(seq) > max_seq_len:
-            continue
-        strings.extend(
-            sequence_strings(desc, seq, rng, prob_invert_seq_annotation,
-                            sort_annotations)
+    with tempfile.TemporaryFile() as spool:
+        pos = 0
+        for desc, seq in parse_fasta(read_from):
+            if len(seq) > max_seq_len:
+                continue
+            for s in sequence_strings(desc, seq, rng,
+                                      prob_invert_seq_annotation,
+                                      sort_annotations):
+                spool.write(s)
+                offsets.append(pos)
+                lengths.append(len(s))
+                pos += len(s)
+            taken += 1
+            if num_samples is not None and taken >= num_samples:
+                break
+
+        def read_string(i: int) -> bytes:
+            spool.seek(offsets[i])
+            return spool.read(lengths[i])
+
+        n_strings = len(offsets)
+        perm = rng.permutation(n_strings)
+        num_valid = math.ceil(fraction_valid_data * n_strings)
+        valid_idx, train_idx = perm[:num_valid], perm[num_valid:]
+        return _write_splits(
+            write_to, read_string, train_idx, valid_idx,
+            num_sequences_per_file,
         )
-        taken += 1
-        if num_samples is not None and taken >= num_samples:
-            break
 
-    perm = rng.permutation(len(strings))
-    num_valid = math.ceil(fraction_valid_data * len(strings))
-    valid_idx, train_idx = perm[:num_valid], perm[num_valid:]
 
+def _write_splits(write_to, read_string, train_idx, valid_idx,
+                  num_sequences_per_file):
     is_gcs = write_to.startswith("gs://")
     if is_gcs:
         from etils import epath
@@ -150,7 +175,7 @@ def generate_tfrecords(
         num_shards = math.ceil(len(idx) / num_sequences_per_file)
         for file_index, shard_idx in enumerate(np.array_split(idx, num_shards)):
             name = shard_filename(file_index, len(shard_idx), split)
-            payloads = [strings[i] for i in shard_idx]
+            payloads = (read_string(int(i)) for i in shard_idx)
             if is_gcs:
                 staged = local_stage / name
                 write_tfrecord(staged, payloads)

@@ -117,6 +117,7 @@ class LocalAttention(nn.Module):
     dim_head: int
     shift: bool
     policy: Policy
+    attn_impl: str = "xla"  # "xla" | "pallas"
 
     @nn.compact
     def __call__(self, x, sin, cos):
@@ -141,8 +142,17 @@ class LocalAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("act_batch", "act_heads", "act_seq", None))
         v = nn.with_logical_constraint(v, ("act_batch", "act_heads", "act_seq", None))
 
-        out = local_attention(q, k, v, window_size=self.window_size,
-                              scale=d ** -0.5)
+        if self.attn_impl == "pallas":
+            from progen_tpu.ops.pallas_attention import pallas_local_attention
+
+            out = pallas_local_attention(q, k, v, self.window_size, d ** -0.5)
+        elif self.attn_impl == "xla":
+            out = local_attention(q, k, v, window_size=self.window_size,
+                                  scale=d ** -0.5)
+        else:
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; use 'xla' or 'pallas'"
+            )
         out = out.transpose(0, 2, 1, 3).reshape(b, n, inner)
         return _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
                       policy=self.policy, name="to_out")(out)
@@ -250,6 +260,7 @@ class ProGen(nn.Module):
     config: ProGenConfig
     policy: Policy = dataclasses.field(default_factory=make_policy)
     remat: bool = False
+    attn_impl: str = "xla"  # "xla" | "pallas" (TPU windowed flash kernel)
 
     @nn.compact
     def __call__(self, tokens):
@@ -297,6 +308,7 @@ class ProGen(nn.Module):
                 dim_head=cfg.dim_head,
                 shift=cfg.shift_tokens,
                 policy=self.policy,
+                attn_impl=self.attn_impl,
                 name=f"attn{i}",
             )(x, sin, cos)
             x = x + ff_cls(

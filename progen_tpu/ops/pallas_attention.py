@@ -1,0 +1,137 @@
+"""Pallas TPU kernel for windowed local attention.
+
+Why a kernel when XLA already fuses well here: the XLA path
+(``ops/local_attention.py``) materializes the ``[previous ‖ own]`` key/value
+concat — every k/v window is written to and re-read from HBM twice
+(``concat_previous_window``).  This kernel instead maps each grid step
+``(bh, j)`` onto the SAME k/v arrays through two BlockSpec index maps (one
+shifted by -1), so each window is streamed from HBM once, and the mask +
+f32 softmax + both matmuls run fused in VMEM on blocks shaped for the MXU
+(wsz x d with d in {64, 128}).
+
+Window-0 semantics match the reference exactly (``progen.py:90-95``): the
+phantom previous window contributes ZERO logits (not -inf) over zero
+values; implemented by zeroing the shifted block's contribution when
+``j == 0`` (the index map clamps j-1 to 0, the kernel masks).
+
+Forward-only kernel + ``jax.custom_vjp``: the backward pass recomputes
+through the XLA path (standard flash-attention-style rematerialized
+backward; the reference model's backward has no kernel to compare against).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from progen_tpu.ops.local_attention import ATTN_MASK_VALUE, local_attention
+
+
+def _kernel(q_ref, kp_ref, ko_ref, vp_ref, vo_ref, o_ref, *, scale: float):
+    j = pl.program_id(1)
+    q = q_ref[0]            # (wsz, d)
+    k_prev = kp_ref[0]      # (wsz, d) — window j-1 (clamped at 0)
+    k_own = ko_ref[0]
+    v_prev = vp_ref[0]
+    v_own = vo_ref[0]
+    wsz = q.shape[0]
+
+    s_prev = jax.lax.dot_general(
+        q, k_prev, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s_own = jax.lax.dot_general(
+        q, k_own, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    # window 0: phantom zero-pad previous window -> zero logits over zero
+    # values (reference semantics), not -inf
+    is_first = (j == 0)
+    s_prev = jnp.where(is_first, 0.0, s_prev)
+
+    # own-window causal mask: query i sees own keys <= i
+    rows = jax.lax.broadcasted_iota(jnp.int32, (wsz, wsz), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (wsz, wsz), 1)
+    s_own = jnp.where(rows >= cols, s_own, ATTN_MASK_VALUE)
+
+    m = jnp.maximum(
+        jnp.max(s_prev, axis=-1, keepdims=True),
+        jnp.max(s_own, axis=-1, keepdims=True),
+    )
+    p_prev = jnp.exp(s_prev - m)
+    p_own = jnp.exp(s_own - m)
+    denom = jnp.sum(p_prev, -1, keepdims=True) + jnp.sum(p_own, -1, keepdims=True)
+
+    v_prev_eff = jnp.where(is_first, jnp.zeros_like(v_prev), v_prev)
+    acc = jax.lax.dot_general(
+        p_prev.astype(v_prev.dtype), v_prev_eff, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc = acc + jax.lax.dot_general(
+        p_own.astype(v_own.dtype), v_own, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = (acc / denom).astype(o_ref.dtype)
+
+
+def _forward(q, k, v, window_size: int, scale: float, interpret: bool):
+    b, h, n, d = q.shape
+    wsz = window_size
+    w = n // wsz
+    bh = b * h
+    qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
+
+    block = (1, wsz, d)
+    own = pl.BlockSpec(block, lambda bh_, j: (bh_, j, 0))
+    prev = pl.BlockSpec(
+        block, lambda bh_, j: (bh_, jnp.maximum(j - 1, 0), 0)
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale),
+        grid=(bh, w),
+        in_specs=[own, prev, own, prev, own],
+        out_specs=own,
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, kf, vf, vf)
+    return out.reshape(b, h, n, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def pallas_local_attention(q, k, v, window_size: int, scale: float | None = None,
+                           interpret: bool | None = None):
+    """Drop-in for :func:`~progen_tpu.ops.local_attention.local_attention`
+    on ``(B, H, L, Dh)`` tensors.  ``interpret=None`` auto-selects the
+    Pallas interpreter off-TPU (tests on CPU)."""
+    b, h, n, d = q.shape
+    if n % window_size != 0:
+        raise ValueError(
+            f"sequence length {n} must be divisible by window {window_size}"
+        )
+    scale_v = d ** -0.5 if scale is None else scale
+    interp = jax.default_backend() != "tpu" if interpret is None else interpret
+    return _forward(q, k, v, window_size, scale_v, interp)
+
+
+def _fwd(q, k, v, window_size, scale, interpret):
+    out = pallas_local_attention(q, k, v, window_size, scale, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(window_size, scale, interpret, res, g):
+    q, k, v = res
+    # rematerialized backward through the XLA path (identical math)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: local_attention(q_, k_, v_,
+                                           window_size=window_size,
+                                           scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+pallas_local_attention.defvjp(_fwd, _bwd)

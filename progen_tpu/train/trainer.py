@@ -57,6 +57,7 @@ class TrainerConfig:
     strategies: Sequence[str] = ("dp",)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     remat: bool = False
+    attn_impl: str = "xla"  # "xla" | "pallas"
     log_every: int = 10
     sample_top_k: int = 25         # reference hardcodes 25 (train.py:224)
     profile_dir: str | None = None
@@ -78,8 +79,21 @@ class Trainer:
         self.data_path = data_path
         self.policy = make_policy(cfg.mixed_precision)
         self.model = ProGen(config=model_config, policy=self.policy,
-                            remat=cfg.remat)
+                            remat=cfg.remat, attn_impl=cfg.attn_impl)
         self.mesh: Mesh | None = make_mesh(cfg.mesh) if use_mesh else None
+        if (
+            cfg.attn_impl == "pallas"
+            and self.mesh is not None
+            and self.mesh.size > 1
+        ):
+            # pl.pallas_call has no GSPMD partitioning rule: under a >1-chip
+            # mesh XLA would all-gather q/k/v around the kernel, silently
+            # destroying the sharding. Multi-chip pallas needs the kernel
+            # invoked inside shard_map (planned); reject until then.
+            raise ValueError(
+                "attn_impl='pallas' currently supports single-chip meshes "
+                "only; use attn_impl='xla' with sharded strategies"
+            )
         self.optimizer = make_optimizer(
             learning_rate=cfg.learning_rate,
             weight_decay=cfg.weight_decay,
@@ -135,6 +149,11 @@ class Trainer:
         assert total_valid > 0, "no protein sequences found for validation"
 
         state, start_seq_index, _ = self.restore_or_init()
+        # the stored cursor can point past the corpus (checkpoint taken at
+        # an epoch's last step); skip past-the-end would empty the stream —
+        # wrap to the in-epoch position (latent bug in the reference, whose
+        # tf.data skip() of >corpus yields an empty dataset, data.py:56)
+        start_seq_index = start_seq_index % total_train
 
         # global effective batch: all hosts' micro-batches x accumulation
         effective_batch = cfg.batch_size * cfg.grad_accum_every * process_count
@@ -174,7 +193,7 @@ class Trainer:
                         batch = jnp.asarray(next(train_it))
                         state, metrics = self.fns.train_step(state, batch)
                     global_step += 1
-                    seq_cursor += effective_batch
+                    seq_cursor = (seq_cursor + effective_batch) % total_train
                     self.meter.tick(effective_batch * seq_len)
 
                     if global_step % cfg.log_every == 0:

@@ -58,6 +58,8 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
               help="mesh axis sizes data,fsdp,tensor,seq (-1 = remaining)")
 @click.option("--remat", default=False, is_flag=True,
               help="rematerialize blocks in backward (saves HBM)")
+@click.option("--attn_impl", default="xla", type=click.Choice(["xla", "pallas"]),
+              help="windowed attention implementation")
 @click.option("--log_every", default=10)
 @click.option("--max_steps", default=None, type=int)
 @click.option("--profile_dir", default=None, type=str)
@@ -120,6 +122,7 @@ def main(**flags):
         strategies=tuple(flags["strategies"].split(",")),
         mesh=mesh_cfg,
         remat=flags["remat"],
+        attn_impl=flags["attn_impl"],
         log_every=flags["log_every"],
         max_steps=flags["max_steps"],
         profile_dir=flags["profile_dir"],
