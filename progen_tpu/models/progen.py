@@ -34,12 +34,20 @@ from typing import Any, Mapping
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from progen_tpu.core.precision import Policy, make_policy
 from progen_tpu.ops.local_attention import local_attention
 from progen_tpu.ops.rotary import apply_rotary_pos_emb, fixed_pos_embedding
 from progen_tpu.ops.sgu import spatial_gate
 from progen_tpu.ops.shift import shift_tokens
+
+
+def _cp_active(mesh: Mesh | None, axis: str = "seq") -> bool:
+    """True when the model should route sequence mixing through the explicit
+    halo-exchange / all-gather context-parallel ops
+    (``progen_tpu/parallel/context.py``) instead of the single-device ops."""
+    return mesh is not None and mesh.shape.get(axis, 1) > 1
 
 # kwargs the reference accepts but never reads (progen.py:201-202) plus
 # driver-level kwargs that are not model architecture.
@@ -118,6 +126,7 @@ class LocalAttention(nn.Module):
     shift: bool
     policy: Policy
     attn_impl: str = "xla"  # "xla" | "pallas"
+    mesh: Mesh | None = None  # seq axis >1 -> context-parallel halo path
 
     @nn.compact
     def __call__(self, x, sin, cos):
@@ -142,7 +151,20 @@ class LocalAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("act_batch", "act_heads", "act_seq", None))
         v = nn.with_logical_constraint(v, ("act_batch", "act_heads", "act_seq", None))
 
-        if self.attn_impl == "pallas":
+        if _cp_active(self.mesh):
+            if self.attn_impl == "pallas":
+                raise ValueError(
+                    "attn_impl='pallas' cannot run under a seq-sharded mesh "
+                    "yet — the context-parallel path uses the XLA windowed "
+                    "attention inside shard_map; use attn_impl='xla' with sp"
+                )
+            from progen_tpu.parallel.context import cp_local_attention
+
+            out = cp_local_attention(
+                q, k, v, mesh=self.mesh, window_size=self.window_size,
+                scale=d ** -0.5,
+            )
+        elif self.attn_impl == "pallas":
             from progen_tpu.ops.pallas_attention import pallas_local_attention
 
             out = pallas_local_attention(q, k, v, self.window_size, d ** -0.5)
@@ -170,6 +192,7 @@ class SGU(nn.Module):
     dim_out: int
     policy: Policy
     eps: float = 1e-3
+    mesh: Mesh | None = None  # seq axis >1 -> sharded spatial matmul
 
     @nn.compact
     def __call__(self, x):
@@ -199,8 +222,18 @@ class SGU(nn.Module):
             self.policy.param_dtype,
         )
 
-        gate = spatial_gate(gate, weights.astype(self.policy.compute_dtype),
-                            biases.astype(self.policy.compute_dtype))
+        if _cp_active(self.mesh):
+            from progen_tpu.parallel.context import cp_spatial_gate
+
+            gate = cp_spatial_gate(
+                gate,
+                weights.astype(self.policy.compute_dtype),
+                biases.astype(self.policy.compute_dtype),
+                mesh=self.mesh,
+            )
+        else:
+            gate = spatial_gate(gate, weights.astype(self.policy.compute_dtype),
+                                biases.astype(self.policy.compute_dtype))
         x = x * gate
         return _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
                       policy=self.policy, name="proj_out")(x)
@@ -220,6 +253,7 @@ class FeedForward(nn.Module):
     use_sgu: bool
     shift: bool
     policy: Policy
+    mesh: Mesh | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -242,7 +276,7 @@ class FeedForward(nn.Module):
 
         if self.use_sgu:
             x = SGU(seq_len=self.seq_len, dim_out=hidden // 2,
-                    policy=self.policy, name="sgu")(x)
+                    policy=self.policy, mesh=self.mesh, name="sgu")(x)
 
         return _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
                       policy=self.policy, name="proj_out")(x)
@@ -261,6 +295,11 @@ class ProGen(nn.Module):
     policy: Policy = dataclasses.field(default_factory=make_policy)
     remat: bool = False
     attn_impl: str = "xla"  # "xla" | "pallas" (TPU windowed flash kernel)
+    # With a mesh whose 'seq' axis is >1, sequence mixing (attention windows,
+    # SGU spatial matmul) runs through the explicit context-parallel ops
+    # (shard_map + ppermute/all_gather) instead of relying on GSPMD to invent
+    # collectives for the window structure.
+    mesh: Mesh | None = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -309,6 +348,7 @@ class ProGen(nn.Module):
                 shift=cfg.shift_tokens,
                 policy=self.policy,
                 attn_impl=self.attn_impl,
+                mesh=self.mesh,
                 name=f"attn{i}",
             )(x, sin, cos)
             x = x + ff_cls(
@@ -319,6 +359,7 @@ class ProGen(nn.Module):
                 use_sgu=use_gmlp,
                 shift=cfg.shift_tokens,
                 policy=self.policy,
+                mesh=self.mesh,
                 name=f"ff{i}",
             )(x)
             x = nn.with_logical_constraint(x, ("act_batch", "act_seq", "act_embed"))

@@ -19,21 +19,19 @@ communication patterns:
   causal masking uses GLOBAL row indices derived from the shard index.
 
 Both functions are drop-in equivalents of their single-device ops — the
-tests assert exact agreement — and are used via ``shard_map`` so the
-collectives are explicit and XLA schedules them against compute.
+tests assert exact agreement — and run under PARTIAL-MANUAL ``shard_map``:
+only the ``seq`` mesh axis is manual (``axis_names={seq}``), so batch/fsdp/
+tensor shardings on the same tensors keep flowing through GSPMD and the
+ops compose with the dp/fsdp/tp rule sets.  They are called from inside
+the model forward (``progen_tpu/models/progen.py``) whenever the model is
+built with a mesh whose ``seq`` axis is >1.
 """
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
-
-from progen_tpu.ops.local_attention import local_attention
-from progen_tpu.ops.sgu import spatial_gate
 
 
 def _left_halo(t, axis_name: str):
@@ -59,6 +57,7 @@ def cp_local_attention(
     Requires ``L_local % window_size == 0`` (shard boundaries align to
     windows — the natural layout for this model).
     """
+    from progen_tpu.ops.local_attention import local_attention
 
     def inner(q_loc, k_loc, v_loc):
         b, h, n_loc, d = q_loc.shape
@@ -83,9 +82,9 @@ def cp_local_attention(
         return local_attention(q_loc, k2, v2, window_size=wsz, scale=scale)
 
     spec = P(None, None, seq_axis, None)
-    return shard_map(
+    return jax.shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False,
+        axis_names=frozenset({seq_axis}), check_vma=True,
     )(q, k, v)
 
 
@@ -96,12 +95,24 @@ def cp_spatial_gate(
     ``weights (L, L)``/``biases (L, 1)`` row-sharded; all-gather the gate,
     keep rows local, mask causally by GLOBAL row index."""
     n_total = weights.shape[0]
+    # XLA's CPU backend crashes ("Invalid binary instruction opcode copy" in
+    # AllReducePromotion) when promoting the bf16 reduce-scatter that is the
+    # backward of a bf16 all_gather; gather in f32 there. TPU keeps the
+    # narrow dtype on the wire.
+    on_cpu = mesh.devices.flat[0].platform == "cpu"
 
     def inner(gate_loc, w_loc, b_loc):
         n_loc = w_loc.shape[0]
         idx = jax.lax.axis_index(seq_axis)
         # gather full gate along the sequence: (B, L, D)
-        gate_full = jax.lax.all_gather(gate_loc, seq_axis, axis=1, tiled=True)
+        if on_cpu and gate_loc.dtype == jnp.bfloat16:
+            gate_full = jax.lax.all_gather(
+                gate_loc.astype(jnp.float32), seq_axis, axis=1, tiled=True
+            ).astype(gate_loc.dtype)
+        else:
+            gate_full = jax.lax.all_gather(
+                gate_loc, seq_axis, axis=1, tiled=True
+            )
         rows = idx * n_loc + jnp.arange(n_loc)          # global row ids
         mask = (jnp.arange(n_total)[None, :] <= rows[:, None]).astype(w_loc.dtype)
         w = w_loc * mask
@@ -109,10 +120,11 @@ def cp_spatial_gate(
                            preferred_element_type=jnp.float32)
         return (mixed + b_loc).astype(gate_loc.dtype)
 
-    return shard_map(
+    return jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(P(None, seq_axis, None), P(seq_axis, None), P(seq_axis, None)),
         out_specs=P(None, seq_axis, None),
-        check_rep=False,
+        axis_names=frozenset({seq_axis}),
+        check_vma=True,
     )(gate, weights, biases)

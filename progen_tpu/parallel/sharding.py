@@ -58,12 +58,18 @@ RULE_SETS: dict[str, list[tuple[str, Any]]] = {
 
 
 def logical_rules(strategies: Sequence[str] = ("dp",)) -> list[tuple[str, Any]]:
-    """Merge rule sets; later strategies must not contradict earlier ones
-    (first occurrence of a logical axis wins, matching flax rule semantics
-    where the first matching rule applies)."""
+    """Merge rule sets; first occurrence of a logical axis wins (matching
+    flax rule semantics where the first matching rule applies).
+
+    ``sp`` is merged FIRST regardless of position: the context-parallel ops
+    (``parallel/context.py``) require the SGU spatial weights row-sharded
+    over 'seq' (their shard_map in_specs), so sp's ``spatial_row -> seq``
+    must beat fsdp's ``spatial_row -> fsdp`` whenever both are requested."""
+    ordered = [s for s in strategies if s == "sp"]
+    ordered += [s for s in strategies if s != "sp"]
     merged: list[tuple[str, Any]] = []
     seen: set[str] = set()
-    for s in strategies:
+    for s in ordered:
         for name, axis in RULE_SETS[s]:
             if name not in seen:
                 merged.append((name, axis))

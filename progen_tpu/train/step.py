@@ -94,9 +94,20 @@ def make_train_functions(
             return jax.jit(fn, out_shardings=state_shardings)(key)
         return jax.jit(fn)(key)
 
+    def apply_model(params, ids):
+        # Activate the logical-axis rules (and the mesh, which
+        # with_sharding_constraint needs in scope) while TRACING the model so
+        # every nn.with_logical_constraint in the forward becomes a real GSPMD
+        # sharding constraint; without the context they are no-ops and XLA
+        # must guess intermediate layouts.
+        if mesh is not None:
+            with mesh, nn.logical_axis_rules(logical_rules(strategies)):
+                return model.apply({"params": params}, ids)
+        return model.apply({"params": params}, ids)
+
     def loss_from_batch(params, batch):
         ids, labels = batch[:, :-1], batch[:, 1:]
-        logits = model.apply({"params": params}, ids)
+        logits = apply_model(params, ids)
         return batch_loss(logits, labels)
 
     def train_step(state: TrainState, batch):
@@ -111,7 +122,7 @@ def make_train_functions(
 
     def eval_step(state: TrainState, batch):
         ids, labels = batch[:, :-1], batch[:, 1:]
-        logits = model.apply({"params": state.params}, ids)
+        logits = apply_model(state.params, ids)
         return {"loss": batch_loss(logits, labels),
                 "per_row_loss": cross_entropy(logits, labels)}
 

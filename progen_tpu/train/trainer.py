@@ -78,9 +78,29 @@ class Trainer:
         self.cfg = cfg
         self.data_path = data_path
         self.policy = make_policy(cfg.mixed_precision)
-        self.model = ProGen(config=model_config, policy=self.policy,
-                            remat=cfg.remat, attn_impl=cfg.attn_impl)
         self.mesh: Mesh | None = make_mesh(cfg.mesh) if use_mesh else None
+        # Hand the mesh to the model only when the sp strategy is on: the
+        # model then routes sequence mixing through the explicit
+        # context-parallel ops (halo-exchange attention, sharded SGU).
+        if (
+            self.mesh is not None
+            and self.mesh.shape.get("seq", 1) > 1
+            and "sp" not in cfg.strategies
+        ):
+            raise ValueError(
+                "mesh has seq axis "
+                f"{self.mesh.shape['seq']} but 'sp' is not in strategies "
+                f"{tuple(cfg.strategies)} — the seq devices would replicate "
+                "work; add 'sp' or set MeshConfig(seq=1)"
+            )
+        cp_mesh = (
+            self.mesh
+            if self.mesh is not None and "sp" in cfg.strategies
+            else None
+        )
+        self.model = ProGen(config=model_config, policy=self.policy,
+                            remat=cfg.remat, attn_impl=cfg.attn_impl,
+                            mesh=cp_mesh)
         if (
             cfg.attn_impl == "pallas"
             and self.mesh is not None
