@@ -10,7 +10,8 @@ tokens/sec/chip (at 1.2B on v4-32); >1.0 beats it.
 
 Env overrides: PROGEN_BENCH_CONFIG (default "small"),
 PROGEN_BENCH_BATCH (default 8), PROGEN_BENCH_STEPS (default 10),
-PROGEN_BENCH_ATTN ("xla" | "pallas", default "xla").
+PROGEN_BENCH_ATTN ("xla" | "pallas", default "pallas" — measured faster
+at every config, see benchmarks/attention.md).
 """
 
 from __future__ import annotations
@@ -48,15 +49,18 @@ def main() -> None:
     config_name = os.environ.get("PROGEN_BENCH_CONFIG", "small")
     batch = int(os.environ.get("PROGEN_BENCH_BATCH", "8"))
     steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
-    attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "xla")
+    attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "pallas")
     warmup = 3
 
     cfg = CONFIGS[config_name]
     n_chips = jax.device_count()
     mesh = make_mesh(MeshConfig()) if n_chips > 1 else None
 
+    # pallas on a >1-chip mesh must run full-manual inside shard_map — the
+    # model needs the mesh (same rule the Trainer applies).
     model = ProGen(config=cfg, policy=make_policy(mixed_precision=True),
-                   attn_impl=attn_impl)
+                   attn_impl=attn_impl,
+                   mesh=mesh if attn_impl == "pallas" else None)
     sample = jnp.zeros((batch, cfg.seq_len), jnp.int32)
     fns = make_train_functions(
         model, make_optimizer(2e-4), sample,

@@ -151,13 +151,18 @@ class LocalAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("act_batch", "act_heads", "act_seq", None))
         v = nn.with_logical_constraint(v, ("act_batch", "act_heads", "act_seq", None))
 
-        if _cp_active(self.mesh):
-            if self.attn_impl == "pallas":
-                raise ValueError(
-                    "attn_impl='pallas' cannot run under a seq-sharded mesh "
-                    "yet — the context-parallel path uses the XLA windowed "
-                    "attention inside shard_map; use attn_impl='xla' with sp"
-                )
+        if self.mesh is not None and self.attn_impl == "pallas":
+            # pallas_call has no GSPMD rule — run it full-manual over the
+            # mesh (halo exchange included); covers dp/fsdp/tp/sp meshes.
+            from progen_tpu.parallel.context import (
+                sharded_pallas_local_attention,
+            )
+
+            out = sharded_pallas_local_attention(
+                q, k, v, mesh=self.mesh, window_size=self.window_size,
+                scale=d ** -0.5,
+            )
+        elif _cp_active(self.mesh):
             from progen_tpu.parallel.context import cp_local_attention
 
             out = cp_local_attention(

@@ -47,6 +47,26 @@ def _left_halo(t, axis_name: str):
     )
 
 
+def _haloed_windows(k_loc, v_loc, window_size: int, seq_axis: str):
+    """Shared per-shard halo assembly for both CP attention paths.
+
+    Reshapes the local k/v ``(B, H, L_loc, D)`` into windows, fetches the
+    left neighbour's last window, and returns ``(kw, vw, k_halo, v_halo)``
+    with ``kw/vw (B, H, W_loc, wsz, D)`` and halos ``(B, H, 1, wsz, D)``.
+    """
+    b, h, n_loc, d = k_loc.shape
+    wsz = window_size
+    if n_loc % wsz != 0:
+        raise ValueError(
+            f"local sequence {n_loc} must be divisible by window {wsz}; "
+            "choose a seq-axis size that keeps whole windows per shard"
+        )
+    w_loc = n_loc // wsz
+    kw = k_loc.reshape(b, h, w_loc, wsz, d)
+    vw = v_loc.reshape(b, h, w_loc, wsz, d)
+    return kw, vw, _left_halo(kw, seq_axis), _left_halo(vw, seq_axis)
+
+
 def cp_local_attention(
     q, k, v, *, mesh: Mesh, window_size: int, scale: float | None = None,
     seq_axis: str = "seq",
@@ -60,19 +80,8 @@ def cp_local_attention(
     from progen_tpu.ops.local_attention import local_attention
 
     def inner(q_loc, k_loc, v_loc):
-        b, h, n_loc, d = q_loc.shape
         wsz = window_size
-        if n_loc % wsz != 0:
-            raise ValueError(
-                f"local sequence {n_loc} must be divisible by window {wsz}; "
-                "choose a seq-axis size that keeps whole windows per shard"
-            )
-        w_loc = n_loc // wsz
-        kw = k_loc.reshape(b, h, w_loc, wsz, d)
-        vw = v_loc.reshape(b, h, w_loc, wsz, d)
-
-        k_halo = _left_halo(kw, seq_axis)
-        v_halo = _left_halo(vw, seq_axis)
+        kw, vw, k_halo, v_halo = _haloed_windows(k_loc, v_loc, wsz, seq_axis)
         # previous window of window j: [halo, own windows 0..W-2][j]
         k_prev = jnp.concatenate([k_halo, kw[..., :-1, :, :]], axis=-3)
         v_prev = jnp.concatenate([v_halo, vw[..., :-1, :, :]], axis=-3)
@@ -85,6 +94,52 @@ def cp_local_attention(
     return jax.shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names=frozenset({seq_axis}), check_vma=True,
+    )(q, k, v)
+
+
+def sharded_pallas_local_attention(
+    q, k, v, *, mesh: Mesh, window_size: int, scale: float | None = None,
+    seq_axis: str = "seq", batch_axes=("data", "fsdp"), head_axis: str = "tensor",
+):
+    """The Pallas windowed-attention kernel under a sharded mesh.
+
+    ``pl.pallas_call`` has no GSPMD partitioning rule, so the kernel must
+    see per-device arrays: this wrapper runs it inside a FULL-manual
+    shard_map — batch over ``batch_axes``, heads over ``head_axis``,
+    sequence over ``seq_axis``.  The halo exchange happens on the way in:
+    each shard receives its left neighbour's last k/v window by
+    ``ppermute`` (zeros on the leftmost shard — the reference's phantom
+    window) and hands the kernel EXTENDED k/v, so one code path covers
+    every mesh from single-chip (all axes size 1) to dp x tp x sp.
+
+    Requires exact divisibility: ``B % prod(batch_axes)``,
+    ``H % head_axis``, ``L/seq_axis % window_size`` — the model's standard
+    shapes satisfy all three.
+    """
+    from progen_tpu.ops.pallas_attention import pallas_local_attention_ext
+
+    d = q.shape[-1]
+    scale_v = d ** -0.5 if scale is None else scale
+    interp = mesh.devices.flat[0].platform != "tpu"
+
+    def inner(q_loc, k_loc, v_loc):
+        b, h, n_loc, dd = q_loc.shape
+        wsz = window_size
+        kw, vw, k_halo, v_halo = _haloed_windows(k_loc, v_loc, wsz, seq_axis)
+        k_ext = jnp.concatenate([k_halo, kw], axis=-3).reshape(
+            b, h, n_loc + wsz, dd)
+        v_ext = jnp.concatenate([v_halo, vw], axis=-3).reshape(
+            b, h, n_loc + wsz, dd)
+        return pallas_local_attention_ext(q_loc, k_ext, v_ext, wsz, scale_v,
+                                          interp)
+
+    spec = P(batch_axes, head_axis, seq_axis, None)
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # metadata, which the vma checker requires; this shard_map is full-manual
+    # so there is nothing for the checker to catch anyway.
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
     )(q, k, v)
 
 

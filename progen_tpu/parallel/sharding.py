@@ -19,8 +19,12 @@ the four mesh axes from ``progen_tpu/core/mesh.py``:
               shard_map + ppermute) is the optimized route.  The SGU
               spatial weights shard row-wise.
 
-Strategies compose: rules are merged left-to-right, so ``("fsdp", "tp")``
-gives 2D sharding.  Unlisted logical axes are replicated.
+Strategies compose: rules are merged left-to-right (first occurrence of a
+logical axis wins), with ONE exception — ``sp`` is always merged first,
+because the context-parallel shard_map ops require the SGU spatial
+weights row-sharded over 'seq' regardless of caller order (see
+:func:`logical_rules`).  ``("fsdp", "tp")`` gives 2D sharding.  Unlisted
+logical axes are replicated.
 """
 
 from __future__ import annotations

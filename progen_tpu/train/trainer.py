@@ -79,9 +79,6 @@ class Trainer:
         self.data_path = data_path
         self.policy = make_policy(cfg.mixed_precision)
         self.mesh: Mesh | None = make_mesh(cfg.mesh) if use_mesh else None
-        # Hand the mesh to the model only when the sp strategy is on: the
-        # model then routes sequence mixing through the explicit
-        # context-parallel ops (halo-exchange attention, sharded SGU).
         if (
             self.mesh is not None
             and self.mesh.shape.get("seq", 1) > 1
@@ -93,27 +90,19 @@ class Trainer:
                 f"{tuple(cfg.strategies)} — the seq devices would replicate "
                 "work; add 'sp' or set MeshConfig(seq=1)"
             )
+        # The model needs the mesh when sequence mixing must be explicit:
+        # sp routes attention/SGU through the context-parallel ops, and
+        # pallas attention always runs full-manual inside shard_map on a
+        # mesh (pallas_call has no GSPMD partitioning rule).
         cp_mesh = (
             self.mesh
-            if self.mesh is not None and "sp" in cfg.strategies
+            if self.mesh is not None
+            and ("sp" in cfg.strategies or cfg.attn_impl == "pallas")
             else None
         )
         self.model = ProGen(config=model_config, policy=self.policy,
                             remat=cfg.remat, attn_impl=cfg.attn_impl,
                             mesh=cp_mesh)
-        if (
-            cfg.attn_impl == "pallas"
-            and self.mesh is not None
-            and self.mesh.size > 1
-        ):
-            # pl.pallas_call has no GSPMD partitioning rule: under a >1-chip
-            # mesh XLA would all-gather q/k/v around the kernel, silently
-            # destroying the sharding. Multi-chip pallas needs the kernel
-            # invoked inside shard_map (planned); reject until then.
-            raise ValueError(
-                "attn_impl='pallas' currently supports single-chip meshes "
-                "only; use attn_impl='xla' with sharded strategies"
-            )
         self.optimizer = make_optimizer(
             learning_rate=cfg.learning_rate,
             weight_decay=cfg.weight_decay,
