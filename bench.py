@@ -1,17 +1,28 @@
 """Benchmark: training-step throughput, tokens/sec/chip.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}``
+``{"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
+"mfu": N, "params": N}``
 
 The metric matches BASELINE.md: Uniref50-shaped training throughput
 (ProGen-small class model, seq_len 1024, bf16 compute).  ``vs_baseline``
 is measured against the driver BASELINE.json north star of 40k
-tokens/sec/chip (at 1.2B on v4-32); >1.0 beats it.
+tokens/sec/chip (at 1.2B on v4-32); >1.0 beats it.  ``mfu`` is the
+model-FLOPs-utilization estimate (6N dense + windowed-attention matmul
+FLOPs, fwd+bwd, over the chip's peak bf16 FLOP/s) so throughput numbers
+are honest about model scale.
 
 Env overrides: PROGEN_BENCH_CONFIG (default "small"),
 PROGEN_BENCH_BATCH (default 8), PROGEN_BENCH_STEPS (default 10),
 PROGEN_BENCH_ATTN ("xla" | "pallas", default "pallas" — measured faster
-at every config, see benchmarks/attention.md).
+at every config, see benchmarks/attention.md),
+PROGEN_BENCH_REMAT ("0"/"1", default on for base/large/xl),
+PROGEN_BENCH_PEAK_TFLOPS (default 197 = TPU v5e bf16),
+PROGEN_BENCH_MODE ("train" | "fwdbwd", default "train") — "fwdbwd" times
+loss+gradients WITHOUT optimizer state, the only way to run the 1.2B+
+configs on a single 16GB v5e chip (f32 Adam moments alone exceed HBM;
+the north-star v4-32 setting shards them over fsdp).  The metric string
+labels the mode so the numbers cannot be confused.
 """
 
 from __future__ import annotations
@@ -26,6 +37,14 @@ import numpy as np
 
 NORTH_STAR_TOKENS_PER_SEC_PER_CHIP = 40_000.0
 
+# bf16 peak by device kind; fallback taken from PROGEN_BENCH_PEAK_TFLOPS
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+}
+
 
 def synthetic_uniref_batch(rng: np.random.Generator, batch: int, seq_len: int):
     """Uniref50-shaped rows: '# ' + uppercase residues, +1 offset, BOS col,
@@ -39,6 +58,16 @@ def synthetic_uniref_batch(rng: np.random.Generator, batch: int, seq_len: int):
     return out
 
 
+def model_flops_per_token(cfg, num_params: int) -> float:
+    """Training FLOPs (fwd+bwd) per token: the standard 6N for every dense
+    parameter (the SGU spatial weights are parameters, so 6N covers them)
+    plus the windowed-attention score/value matmuls, which touch 2*wsz keys
+    per query: fwd 8*wsz*inner FLOPs/token/layer, x3 with the backward."""
+    inner = cfg.heads * cfg.dim_head
+    attn = 24.0 * cfg.window_size * inner * cfg.depth
+    return 6.0 * num_params + attn
+
+
 def main() -> None:
     from progen_tpu.core.mesh import MeshConfig, make_mesh
     from progen_tpu.core.precision import make_policy
@@ -50,6 +79,10 @@ def main() -> None:
     batch = int(os.environ.get("PROGEN_BENCH_BATCH", "8"))
     steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
     attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "pallas")
+    mode = os.environ.get("PROGEN_BENCH_MODE", "train")
+    remat_default = config_name in ("base", "large", "xl")
+    remat = os.environ.get("PROGEN_BENCH_REMAT",
+                           "1" if remat_default else "0") == "1"
     warmup = 3
 
     cfg = CONFIGS[config_name]
@@ -59,14 +92,9 @@ def main() -> None:
     # pallas on a >1-chip mesh must run full-manual inside shard_map — the
     # model needs the mesh (same rule the Trainer applies).
     model = ProGen(config=cfg, policy=make_policy(mixed_precision=True),
-                   attn_impl=attn_impl,
+                   attn_impl=attn_impl, remat=remat,
                    mesh=mesh if attn_impl == "pallas" else None)
     sample = jnp.zeros((batch, cfg.seq_len), jnp.int32)
-    fns = make_train_functions(
-        model, make_optimizer(2e-4), sample,
-        mesh=mesh, strategies=("dp",),
-    )
-    state = fns.init_state(jax.random.key(0))
 
     rng = np.random.default_rng(0)
     batches = [
@@ -74,32 +102,84 @@ def main() -> None:
         for _ in range(4)
     ]
 
+    if mode == "train":
+        fns = make_train_functions(
+            model, make_optimizer(2e-4), sample,
+            mesh=mesh, strategies=("dp",),
+        )
+        state = fns.init_state(jax.random.key(0))
+        num_params = sum(x.size for x in jax.tree.leaves(state.params))
+        run = lambda s, b: fns.train_step(s, b)
+    elif mode == "fwdbwd":
+        # loss + gradients only: no optimizer state, so the 1.2B+ configs
+        # fit a single 16GB chip.  The grad norm is a returned output, so
+        # the backward cannot be dead-code-eliminated — and no param-sized
+        # copy is written (this mode exists to live at the HBM edge).
+        import optax
+
+        from progen_tpu.parallel import unbox
+        from progen_tpu.train.loss import batch_loss
+
+        params = unbox(jax.jit(model.init)(jax.random.key(0), sample))["params"]
+        num_params = sum(x.size for x in jax.tree.leaves(params))
+
+        def loss_fn(p, b):
+            logits = model.apply({"params": p}, b[:, :-1])
+            return batch_loss(logits, b[:, 1:])
+
+        @jax.jit
+        def fwdbwd_step(p, b):
+            loss, grads = jax.value_and_grad(loss_fn)(p, b)
+            return {"loss": loss, "grad_norm": optax.global_norm(grads)}
+
+        state = params
+        run = lambda s, b: (s, fwdbwd_step(s, b))
+    else:
+        raise ValueError(f"unknown PROGEN_BENCH_MODE {mode!r}")
+
+    # host transfer of grad_norm: the only reliable full sync on tunneled
+    # backends where block_until_ready can return early; grad_norm (not
+    # loss) so the backward is a live output in both modes
     for i in range(warmup):
-        state, metrics = fns.train_step(state, batches[i % len(batches)])
-    float(metrics["loss"])  # host transfer: the only reliable full sync on
-    # tunneled backends where block_until_ready can return early
+        state, metrics = run(state, batches[i % len(batches)])
+    float(metrics["grad_norm"])
 
     t0 = time.perf_counter()
     for i in range(steps):
-        state, metrics = fns.train_step(state, batches[i % len(batches)])
-    float(metrics["loss"])
+        state, metrics = run(state, batches[i % len(batches)])
+    float(metrics["grad_norm"])
     dt = time.perf_counter() - t0
 
     tokens = steps * batch * cfg.seq_len
     tps_chip = tokens / dt / n_chips
+
+    kind = jax.devices()[0].device_kind
+    peak = float(os.environ.get(
+        "PROGEN_BENCH_PEAK_TFLOPS", PEAK_TFLOPS.get(kind, 197.0)
+    )) * 1e12
+    mfu = model_flops_per_token(cfg, num_params) * tps_chip / peak
+
     print(
         json.dumps(
             {
                 "metric": (
-                    f"uniref50-shaped train throughput, ProGen-{config_name} "
+                    f"uniref50-shaped "
+                    f"{'train' if mode == 'train' else 'fwd+bwd (no optimizer)'}"
+                    f" throughput, ProGen-{config_name} "
                     f"(seq_len {cfg.seq_len}, batch {batch}, bf16, "
+                    f"{attn_impl} attn{', remat' if remat else ''}, "
                     f"{n_chips} chip(s))"
                 ),
                 "value": round(tps_chip, 1),
                 "unit": "tokens/sec/chip",
-                "vs_baseline": round(
-                    tps_chip / NORTH_STAR_TOKENS_PER_SEC_PER_CHIP, 3
+                # vs_baseline compares TRAIN steps to the train-step north
+                # star; a lighter fwd+bwd-only run must not claim the ratio
+                "vs_baseline": (
+                    round(tps_chip / NORTH_STAR_TOKENS_PER_SEC_PER_CHIP, 3)
+                    if mode == "train" else None
                 ),
+                "mfu": round(mfu, 4),
+                "params": num_params,
             }
         )
     )
