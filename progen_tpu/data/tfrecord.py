@@ -149,6 +149,16 @@ def iterator_from_tfrecords_folder(
         ds = tf.data.TFRecordDataset(filenames, compression_type="GZIP")
         if process_count > 1:
             ds = ds.shard(process_count, process_index)
+        if loop:
+            # TPU-first ragged-batch fix: repeat the RECORD stream before
+            # skip/batch, so every batch is full and statically shaped (no
+            # jit retrace / sharded-batch divisibility failure at corpus
+            # boundaries — batches simply straddle them), nothing is
+            # dropped, and records before a resume skip reappear in later
+            # passes.  The reference repeats after batching
+            # (data.py:54-62), which emits a short batch every epoch AND
+            # permanently loses the skipped prefix on resume.
+            ds = ds.repeat()
         ds = ds.skip(skip // process_count)
         ds = ds.map(
             lambda rec: tf.io.parse_single_example(
@@ -157,11 +167,15 @@ def iterator_from_tfrecords_folder(
             num_parallel_calls=tf.data.AUTOTUNE,
         )
         if shuffle_buffer:
+            # Under loop=True the repeated stream is ONE infinite iteration,
+            # so reshuffle_each_iteration never fires: mixing across epoch
+            # boundaries comes from the sliding buffer itself (intentional);
+            # the flag only matters for finite re-iterated datasets.
             ds = ds.shuffle(shuffle_buffer, seed=seed, reshuffle_each_iteration=True)
-        ds = ds.batch(batch_size)
+        # an infinite stream never has a remainder; finite (loop=False)
+        # streams keep the reference's trailing short batch
+        ds = ds.batch(batch_size, drop_remainder=loop)
         ds = ds.prefetch(tf.data.AUTOTUNE)
-        if loop:
-            ds = ds.repeat()
         for raw in ds.as_numpy_iterator():
             yield collate(list(raw), seq_len)
 
