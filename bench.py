@@ -17,7 +17,8 @@ PROGEN_BENCH_BATCH (default 8), PROGEN_BENCH_STEPS (default 10),
 PROGEN_BENCH_ATTN ("xla" | "pallas", default "pallas" — measured faster
 at every config, see benchmarks/attention.md),
 PROGEN_BENCH_REMAT ("0"/"1", default on for base/large/xl),
-PROGEN_BENCH_PEAK_TFLOPS (default 197 = TPU v5e bf16),
+PROGEN_BENCH_PEAK_TFLOPS (FALLBACK for unrecognized device kinds only —
+known TPU generations auto-resolve from PEAK_TFLOPS, e.g. v4 -> 275),
 PROGEN_BENCH_MODE ("train" | "fwdbwd", default "train") — "fwdbwd" times
 loss+gradients WITHOUT optimizer state, the only way to run the 1.2B+
 configs on a single 16GB v5e chip (f32 Adam moments alone exceed HBM;
@@ -111,6 +112,13 @@ def main() -> None:
         num_params = sum(x.size for x in jax.tree.leaves(state.params))
         run = lambda s, b: fns.train_step(s, b)
     elif mode == "fwdbwd":
+        if n_chips > 1:
+            # fwdbwd_step is jitted without mesh shardings; dividing by
+            # n_chips would report a per-chip rate no chip actually ran
+            raise SystemExit(
+                "PROGEN_BENCH_MODE=fwdbwd is single-chip only "
+                f"(found {n_chips} devices); use mode=train for multi-chip"
+            )
         # loss + gradients only: no optimizer state, so the 1.2B+ configs
         # fit a single 16GB chip.  The grad norm is a returned output, so
         # the backward cannot be dead-code-eliminated — and no param-sized
