@@ -1,5 +1,6 @@
 from progen_tpu.train.loss import batch_loss, cross_entropy, eos_from_pad_mask
 from progen_tpu.train.optimizer import decay_mask, make_optimizer
+from progen_tpu.train.schedule import SCHEDULES, lr_at, make_lr_schedule
 from progen_tpu.train.step import TrainFunctions, TrainState, make_train_functions
 
 __all__ = [
@@ -8,6 +9,9 @@ __all__ = [
     "eos_from_pad_mask",
     "decay_mask",
     "make_optimizer",
+    "SCHEDULES",
+    "lr_at",
+    "make_lr_schedule",
     "TrainFunctions",
     "TrainState",
     "make_train_functions",

@@ -3,8 +3,9 @@
 Contract (reference ``/root/reference/train.py:117-123``): global-norm clip
 0.5 -> AdamW (lr 2e-4, weight decay 1e-3, decay mask ``ndim > 1`` so
 LayerNorm scales and biases are excluded) -> gradient accumulation every N
-micro-batches.  No LR schedule, no warmup (reference has none; a schedule
-hook is exposed for the TPU build's larger configs).
+micro-batches.  The reference has no LR schedule or warmup; this build adds
+them via :mod:`progen_tpu.train.schedule` — pass the schedule callable as
+``learning_rate``.
 
 Conscious change from the reference: accumulation uses ``optax.MultiSteps``
 (accumulate GRADIENTS, run clip+adamw once per effective batch) instead of

@@ -1,0 +1,88 @@
+"""Learning-rate schedules with warmup.
+
+The reference trains at a fixed 2e-4 with no warmup
+(``/root/reference/train.py:119-123``); that is fine for the toy config but
+not credible at the 1.2B+ scales in BASELINE.md, so the TPU build exposes a
+schedule ladder.  ``make_optimizer`` already accepts a callable learning
+rate — this module builds the callables.
+
+Schedules step once per OPTIMIZER step.  Under gradient accumulation
+(``optax.MultiSteps``) the inner AdamW count only advances once per
+effective batch, so ``warmup_steps``/``decay_steps`` are always counted in
+effective (not micro) steps — no correction factor needed.
+"""
+
+from __future__ import annotations
+
+import optax
+
+SCHEDULES = ("constant", "cosine", "linear")
+
+
+def make_lr_schedule(
+    name: str,
+    base_lr: float,
+    *,
+    warmup_steps: int = 0,
+    decay_steps: int | None = None,
+    min_lr_ratio: float = 0.1,
+) -> float | optax.Schedule:
+    """Build a learning-rate schedule.
+
+    ``name``:
+      * ``"constant"`` — ``base_lr``, with an optional linear warmup from 0
+        over ``warmup_steps``;
+      * ``"cosine"`` — linear warmup to ``base_lr`` then cosine decay to
+        ``base_lr * min_lr_ratio`` at ``decay_steps``;
+      * ``"linear"`` — linear warmup then linear decay to the same floor.
+
+    ``decay_steps`` is the step at which the decaying schedules bottom out
+    (total training steps, inclusive of warmup); required for
+    cosine/linear.  Returns a plain float for the no-warmup constant case
+    so the optimizer state carries no schedule baggage.
+    """
+    if name not in SCHEDULES:
+        raise ValueError(f"unknown lr schedule {name!r}; pick from {SCHEDULES}")
+    if name == "constant":
+        if warmup_steps <= 0:
+            return base_lr
+        return optax.schedules.warmup_constant_schedule(
+            init_value=0.0, peak_value=base_lr, warmup_steps=warmup_steps
+        )
+
+    if decay_steps is None:
+        raise ValueError(
+            f"lr schedule {name!r} needs decay_steps (total optimizer steps); "
+            "pass --schedule_steps or set max_steps"
+        )
+    if decay_steps <= warmup_steps:
+        raise ValueError(
+            f"decay_steps ({decay_steps}) must exceed warmup_steps "
+            f"({warmup_steps})"
+        )
+    end_value = base_lr * min_lr_ratio
+    if name == "cosine":
+        return optax.schedules.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=base_lr,
+            warmup_steps=warmup_steps,
+            decay_steps=decay_steps,
+            end_value=end_value,
+        )
+    # linear: warmup then straight-line decay to the floor
+    return optax.schedules.join_schedules(
+        [
+            optax.schedules.linear_schedule(0.0, base_lr, warmup_steps),
+            optax.schedules.linear_schedule(
+                base_lr, end_value, decay_steps - warmup_steps
+            ),
+        ],
+        boundaries=[warmup_steps],
+    )
+
+
+def lr_at(schedule: float | optax.Schedule, step: int) -> float:
+    """Host-side readout of the lr at an optimizer step (for logging)."""
+    if callable(schedule):
+        return float(schedule(step))
+    return float(schedule)

@@ -45,7 +45,7 @@ class TrainFunctions:
     """Bundle returned by :func:`make_train_functions`.
 
     ``init_state(key)`` creates the (sharded) state; ``train_step(state,
-    key, batch)`` and ``eval_step(state, batch)`` are jitted and mesh-aware.
+    batch)`` and ``eval_step(state, batch)`` are jitted and mesh-aware.
     ``batch`` is the data-pipeline layout ``(B, seq_len + 1)`` int tokens.
     """
 

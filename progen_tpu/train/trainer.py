@@ -34,6 +34,7 @@ from progen_tpu.decode import make_sampler
 from progen_tpu.models import ProGen, ProGenConfig
 from progen_tpu.observe import ThroughputMeter, Tracker, profile_trace
 from progen_tpu.train.optimizer import make_optimizer
+from progen_tpu.train.schedule import lr_at, make_lr_schedule
 from progen_tpu.train.step import make_train_functions
 
 
@@ -53,6 +54,11 @@ class TrainerConfig:
     checkpoint_keep_n: int = 500
     prime_length: int = 25
     mixed_precision: bool = True
+    # LR schedule (reference is constant-lr; warmup/decay needed >=1.2B)
+    lr_schedule: str = "constant"  # "constant" | "cosine" | "linear"
+    warmup_steps: int = 0
+    schedule_steps: int | None = None  # decay horizon; defaults to max_steps
+    lr_min_ratio: float = 0.1
     # TPU-native additions
     strategies: Sequence[str] = ("dp",)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -103,8 +109,15 @@ class Trainer:
         self.model = ProGen(config=model_config, policy=self.policy,
                             remat=cfg.remat, attn_impl=cfg.attn_impl,
                             mesh=cp_mesh)
+        self.lr_schedule = make_lr_schedule(
+            cfg.lr_schedule,
+            cfg.learning_rate,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=cfg.schedule_steps or cfg.max_steps,
+            min_lr_ratio=cfg.lr_min_ratio,
+        )
         self.optimizer = make_optimizer(
-            learning_rate=cfg.learning_rate,
+            learning_rate=self.lr_schedule,
             weight_decay=cfg.weight_decay,
             max_grad_norm=cfg.max_grad_norm,
             grad_accum_every=cfg.grad_accum_every,
@@ -210,6 +223,10 @@ class Trainer:
                         log = {
                             "loss": last_loss,
                             "grad_norm": float(metrics["grad_norm"]),
+                            # the update that produced step N was scaled with
+                            # the schedule read at count N-1 (optax reads the
+                            # count before incrementing)
+                            "lr": lr_at(self.lr_schedule, global_step - 1),
                         }
                         tps = self.meter.tokens_per_sec_per_chip
                         if tps is not None:

@@ -1,0 +1,104 @@
+"""LR schedule shapes and their interaction with gradient accumulation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.train.optimizer import make_optimizer
+from progen_tpu.train.schedule import lr_at, make_lr_schedule
+
+
+def test_constant_no_warmup_is_plain_float():
+    s = make_lr_schedule("constant", 3e-4)
+    assert s == pytest.approx(3e-4)
+    assert lr_at(s, 0) == pytest.approx(3e-4)
+    assert lr_at(s, 10_000) == pytest.approx(3e-4)
+
+
+def test_constant_with_warmup_ramps_then_holds():
+    s = make_lr_schedule("constant", 1e-3, warmup_steps=100)
+    assert lr_at(s, 0) == pytest.approx(0.0)
+    assert lr_at(s, 50) == pytest.approx(5e-4, rel=0.05)
+    assert lr_at(s, 100) == pytest.approx(1e-3)
+    assert lr_at(s, 100_000) == pytest.approx(1e-3)
+
+
+def test_cosine_warmup_peak_floor():
+    s = make_lr_schedule("cosine", 2e-4, warmup_steps=10, decay_steps=110,
+                         min_lr_ratio=0.1)
+    assert lr_at(s, 0) == pytest.approx(0.0)
+    assert lr_at(s, 10) == pytest.approx(2e-4)
+    # midpoint of the cosine: halfway between peak and floor
+    mid = lr_at(s, 60)
+    assert lr_at(s, 110) == pytest.approx(2e-5, rel=1e-3)
+    assert lr_at(s, 10) > mid > lr_at(s, 110)
+    assert mid == pytest.approx((2e-4 + 2e-5) / 2, rel=0.02)
+    # past the horizon: clamped at the floor
+    assert lr_at(s, 10_000) == pytest.approx(2e-5, rel=1e-3)
+
+
+def test_linear_decay_is_straight_line():
+    s = make_lr_schedule("linear", 1e-3, warmup_steps=0, decay_steps=100,
+                         min_lr_ratio=0.0)
+    assert lr_at(s, 0) == pytest.approx(1e-3)
+    assert lr_at(s, 25) == pytest.approx(7.5e-4, rel=1e-3)
+    assert lr_at(s, 50) == pytest.approx(5e-4, rel=1e-3)
+    assert lr_at(s, 100) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_decay_requires_horizon():
+    with pytest.raises(ValueError, match="decay_steps"):
+        make_lr_schedule("cosine", 2e-4, warmup_steps=10)
+    with pytest.raises(ValueError, match="exceed"):
+        make_lr_schedule("linear", 2e-4, warmup_steps=10, decay_steps=5)
+    with pytest.raises(ValueError, match="unknown"):
+        make_lr_schedule("polynomial", 2e-4)
+
+
+def test_schedule_counts_effective_steps_under_accumulation():
+    """With MultiSteps(k=2) and warmup starting at lr=0, the FIRST effective
+    update must be a no-op (lr 0 at inner count 0) — proving the schedule
+    sees optimizer-effective steps, not micro-steps."""
+    sched = make_lr_schedule("constant", 1e-2, warmup_steps=2)
+    tx = make_optimizer(learning_rate=sched, grad_accum_every=2,
+                        weight_decay=0.0)
+    params = {"w": jnp.ones((4, 4))}
+    opt_state = tx.init(params)
+    grads = {"w": jnp.full((4, 4), 0.5)}
+
+    import optax
+
+    p = params
+    # first effective batch: micro-steps 0,1 -> applied at inner count 0
+    for _ in range(2):
+        updates, opt_state = tx.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+    np.testing.assert_allclose(p["w"], params["w"], atol=1e-8)
+
+    # second effective batch -> inner count 1, lr = 1e-2 * 1/2 > 0
+    for _ in range(2):
+        updates, opt_state = tx.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+    assert float(jnp.abs(p["w"] - params["w"]).max()) > 1e-5
+
+
+def test_trainer_config_plumbs_schedule(tmp_path):
+    """Trainer builds its optimizer from the configured schedule (smoke)."""
+    from progen_tpu.models import ProGenConfig
+    from progen_tpu.train.trainer import Trainer, TrainerConfig
+
+    model_config = ProGenConfig(
+        num_tokens=256, dim=64, seq_len=64, depth=1, window_size=32,
+        global_mlp_depth=1, heads=2, dim_head=32, ff_mult=2,
+    )
+    cfg = TrainerConfig(
+        batch_size=2, grad_accum_every=1, mixed_precision=False,
+        lr_schedule="cosine", warmup_steps=5, schedule_steps=50,
+        max_steps=50,
+    )
+    tr = Trainer(model_config, cfg, data_path=str(tmp_path),
+                 checkpoint_path=str(tmp_path / "ckpt"), use_mesh=False)
+    assert lr_at(tr.lr_schedule, 0) == pytest.approx(0.0)
+    assert lr_at(tr.lr_schedule, 5) == pytest.approx(cfg.learning_rate)
+    assert lr_at(tr.lr_schedule, 50) < cfg.learning_rate

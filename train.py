@@ -36,6 +36,16 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--grad_accum_every", default=4)
 @click.option("--epochs", default=100)
 @click.option("--learning_rate", default=2e-4)
+@click.option("--lr_schedule", default="constant",
+              type=click.Choice(["constant", "cosine", "linear"]),
+              help="lr shape; cosine/linear need --schedule_steps or "
+                   "--max_steps as the decay horizon")
+@click.option("--warmup_steps", default=0,
+              help="linear lr warmup over this many optimizer steps")
+@click.option("--schedule_steps", default=None, type=int,
+              help="step at which cosine/linear decay bottoms out")
+@click.option("--lr_min_ratio", default=0.1,
+              help="decay floor as a fraction of --learning_rate")
 @click.option("--weight_decay", default=1e-3)
 @click.option("--max_grad_norm", default=0.5)
 @click.option("--validate_every", default=100)
@@ -111,6 +121,10 @@ def main(**flags):
         grad_accum_every=flags["grad_accum_every"],
         epochs=flags["epochs"],
         learning_rate=flags["learning_rate"],
+        lr_schedule=flags["lr_schedule"],
+        warmup_steps=flags["warmup_steps"],
+        schedule_steps=flags["schedule_steps"],
+        lr_min_ratio=flags["lr_min_ratio"],
         weight_decay=flags["weight_decay"],
         max_grad_norm=flags["max_grad_norm"],
         validate_every=flags["validate_every"],
