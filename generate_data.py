@@ -12,7 +12,10 @@ from pathlib import Path
 @click.option("--data_dir", default="./configs/data")
 @click.option("--name", default="default")
 @click.option("--seed", default=0)
-def main(data_dir, name, seed):
+@click.option("--num_workers", default=None, type=int,
+              help="multiprocessing pool size for formatting + shard "
+                   "compression (default: all cores; 0/1 = serial)")
+def main(data_dir, name, seed, num_workers):
     config_path = Path(data_dir) / f"{name}.toml"
     assert config_path.exists(), f"config does not exist at {config_path}"
     config = tomllib.loads(config_path.read_text())
@@ -29,6 +32,7 @@ def main(data_dir, name, seed):
         prob_invert_seq_annotation=config.get("prob_invert_seq_annotation", 0.5),
         sort_annotations=config.get("sort_annotations", True),
         seed=seed,
+        num_workers=num_workers,
     )
     print(f"wrote {counts['train']} train / {counts['valid']} valid sequences "
           f"to {config['write_to']}")

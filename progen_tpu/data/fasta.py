@@ -92,6 +92,31 @@ def sequence_strings(
     return out
 
 
+def _format_record(args: tuple) -> list[bytes]:
+    """Pool worker: format one FASTA record into its 1-2 training strings.
+
+    The rng is derived from ``(seed, record_index)`` so the output is
+    deterministic and IDENTICAL regardless of worker count or scheduling
+    (the serial path uses the same derivation).
+    """
+    idx, desc, seq, prob_invert, sort_annotations, seed = args
+    rng = np.random.default_rng([seed, idx])
+    return sequence_strings(desc, seq, rng, prob_invert, sort_annotations)
+
+
+def _filtered_records(
+    read_from: str, max_seq_len: int, num_samples: int | None
+) -> Iterator[tuple[int, str, str]]:
+    taken = 0
+    for desc, seq in parse_fasta(read_from):
+        if len(seq) > max_seq_len:
+            continue
+        yield taken, desc, seq
+        taken += 1
+        if num_samples is not None and taken >= num_samples:
+            return
+
+
 def generate_tfrecords(
     read_from: str,
     write_to: str,
@@ -103,9 +128,26 @@ def generate_tfrecords(
     prob_invert_seq_annotation: float = 0.5,
     sort_annotations: bool = True,
     seed: int = 0,
+    num_workers: int | None = None,
 ) -> dict[str, int]:
-    """Run the full prep: returns ``{"train": n, "valid": m}`` counts."""
-    rng = np.random.default_rng(seed)
+    """Run the full prep: returns ``{"train": n, "valid": m}`` counts.
+
+    ``num_workers``: size of the ``multiprocessing`` pool used for record
+    formatting and shard compression (the reference README's "utilize all
+    cores" TODO, ``README.md:109``).  ``None`` -> ``os.cpu_count()``; ``0``
+    or ``1`` -> serial.  Output bytes are identical for every worker count:
+    per-record randomness is keyed by ``(seed, record_index)``, not by a
+    shared stream.
+
+    Workers use the ``spawn`` start method, so the caller's ``__main__``
+    must be importable (a real script/module with an ``if __name__ ==
+    '__main__'`` guard — true of ``generate_data.py`` and pytest; a
+    stdin-piped ``python -`` session must pass ``num_workers<=1``).
+    """
+    import os
+
+    if num_workers is None:
+        num_workers = os.cpu_count() or 1
 
     # Spool encoded strings to one on-disk file, keeping only (offset, len)
     # per string in RAM — full-corpus Uniref50 emits tens of GB of strings,
@@ -116,39 +158,61 @@ def generate_tfrecords(
 
     offsets: list[int] = []
     lengths: list[int] = []
-    taken = 0
     with tempfile.TemporaryFile() as spool:
-        pos = 0
-        for desc, seq in parse_fasta(read_from):
-            if len(seq) > max_seq_len:
-                continue
-            for s in sequence_strings(desc, seq, rng,
-                                      prob_invert_seq_annotation,
-                                      sort_annotations):
-                spool.write(s)
-                offsets.append(pos)
-                lengths.append(len(s))
-                pos += len(s)
-            taken += 1
-            if num_samples is not None and taken >= num_samples:
-                break
+        args = (
+            (idx, desc, seq, prob_invert_seq_annotation, sort_annotations,
+             seed)
+            for idx, desc, seq in _filtered_records(
+                read_from, max_seq_len, num_samples)
+        )
+        if num_workers > 1:
+            # spawn (not fork): the parent may hold live JAX/TF runtimes
+            # whose locks do not survive fork; workers only import
+            # numpy + this module, so spawn startup is cheap.
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+            with ctx.Pool(num_workers) as pool:
+                string_lists = pool.imap(_format_record, args, chunksize=256)
+                pos = _spool_strings(spool, string_lists, offsets, lengths)
+        else:
+            pos = _spool_strings(
+                spool, map(_format_record, args), offsets, lengths)
 
         def read_string(i: int) -> bytes:
             spool.seek(offsets[i])
             return spool.read(lengths[i])
 
         n_strings = len(offsets)
+        rng = np.random.default_rng(seed)
         perm = rng.permutation(n_strings)
         num_valid = math.ceil(fraction_valid_data * n_strings)
         valid_idx, train_idx = perm[:num_valid], perm[num_valid:]
         return _write_splits(
             write_to, read_string, train_idx, valid_idx,
-            num_sequences_per_file,
+            num_sequences_per_file, num_workers,
         )
 
 
+def _spool_strings(spool, string_lists, offsets, lengths) -> int:
+    pos = 0
+    for strings in string_lists:
+        for s in strings:
+            spool.write(s)
+            offsets.append(pos)
+            lengths.append(len(s))
+            pos += len(s)
+    return pos
+
+
+def _write_shard(args: tuple) -> None:
+    """Pool worker: gzip-compress and write one complete shard file."""
+    path, payloads = args
+    write_tfrecord(path, payloads)
+
+
 def _write_splits(write_to, read_string, train_idx, valid_idx,
-                  num_sequences_per_file):
+                  num_sequences_per_file, num_workers=1):
     is_gcs = write_to.startswith("gs://")
     if is_gcs:
         from etils import epath
@@ -168,18 +232,39 @@ def _write_splits(write_to, read_string, train_idx, valid_idx,
         out_dir.mkdir(parents=True, exist_ok=True)
 
     counts = {}
-    for split, idx in (("train", train_idx), ("valid", valid_idx)):
-        counts[split] = len(idx)
-        if len(idx) == 0:
-            continue
-        num_shards = math.ceil(len(idx) / num_sequences_per_file)
-        for file_index, shard_idx in enumerate(np.array_split(idx, num_shards)):
-            name = shard_filename(file_index, len(shard_idx), split)
-            payloads = (read_string(int(i)) for i in shard_idx)
-            if is_gcs:
-                staged = local_stage / name
-                write_tfrecord(staged, payloads)
-                (out_dir / name).write_bytes(staged.read_bytes())
-            else:
-                write_tfrecord(out_dir / name, payloads)
+    staged_uploads: list[tuple] = []
+
+    def shard_tasks():
+        for split, idx in (("train", train_idx), ("valid", valid_idx)):
+            counts[split] = len(idx)
+            if len(idx) == 0:
+                continue
+            num_shards = math.ceil(len(idx) / num_sequences_per_file)
+            for file_index, shard_idx in enumerate(
+                np.array_split(idx, num_shards)
+            ):
+                name = shard_filename(file_index, len(shard_idx), split)
+                payloads = [read_string(int(i)) for i in shard_idx]
+                if is_gcs:
+                    staged = local_stage / name
+                    staged_uploads.append((staged, out_dir / name))
+                    yield str(staged), payloads
+                else:
+                    yield str(out_dir / name), payloads
+
+    if num_workers > 1:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(num_workers) as pool:
+            # imap over the lazy generator: at most ~num_workers shards'
+            # payloads are pickled/in flight at once, never the full corpus
+            for _ in pool.imap(_write_shard, shard_tasks(), chunksize=1):
+                pass
+    else:
+        for task in shard_tasks():
+            _write_shard(task)
+
+    for staged, dest in staged_uploads:
+        dest.write_bytes(staged.read_bytes())
     return counts

@@ -90,6 +90,35 @@ def test_generate_tfrecords_roundtrip(fasta_path, tmp_path):
     assert all("#" in t for t in texts)
 
 
+def test_parallel_prep_matches_serial(tmp_path):
+    """The multiprocessing pool path must produce byte-identical shards to
+    the serial path (per-record rng keyed by (seed, index), not worker
+    order)."""
+    # enough records that shards and pool chunks are non-trivial
+    lines = []
+    for i in range(40):
+        tax = f" Tax=Genus{i} TaxID={i}" if i % 3 == 0 else ""
+        lines.append(f">UniRef50_X{i:03d} protein n={i}{tax}")
+        lines.append("MKLV" * (3 + i % 7))
+    p = tmp_path / "many.fasta"
+    p.write_text("\n".join(lines) + "\n")
+
+    kwargs = dict(fraction_valid_data=0.1, num_sequences_per_file=8, seed=3)
+    serial = generate_tfrecords(str(p), str(tmp_path / "serial"),
+                                num_workers=1, **kwargs)
+    pooled = generate_tfrecords(str(p), str(tmp_path / "pooled"),
+                                num_workers=2, **kwargs)
+    assert serial == pooled
+
+    serial_files = sorted(f.name for f in (tmp_path / "serial").iterdir())
+    pooled_files = sorted(f.name for f in (tmp_path / "pooled").iterdir())
+    assert serial_files == pooled_files
+    for name in serial_files:
+        a = (tmp_path / "serial" / name).read_bytes()
+        b = (tmp_path / "pooled" / name).read_bytes()
+        assert a == b, f"shard {name} differs between serial and pooled"
+
+
 def test_generate_is_deterministic(fasta_path, tmp_path):
     a = generate_tfrecords(str(fasta_path), str(tmp_path / "a"), seed=7,
                            fraction_valid_data=0.0)

@@ -37,8 +37,8 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--epochs", default=100)
 @click.option("--learning_rate", default=2e-4)
 @click.option("--lr_schedule", default="constant",
-              type=click.Choice(["constant", "cosine", "linear"]),
-              help="lr shape; cosine/linear need --schedule_steps or "
+              help="lr shape (progen_tpu.train.SCHEDULES: constant, cosine, "
+                   "linear); cosine/linear need --schedule_steps or "
                    "--max_steps as the decay horizon")
 @click.option("--warmup_steps", default=0,
               help="linear lr warmup over this many optimizer steps")
