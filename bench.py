@@ -84,6 +84,7 @@ def main() -> None:
     remat_default = config_name in ("base", "large", "xl")
     remat = os.environ.get("PROGEN_BENCH_REMAT",
                            "1" if remat_default else "0") == "1"
+    remat_policy = os.environ.get("PROGEN_BENCH_REMAT_POLICY", "full")
     warmup = 3
 
     cfg = CONFIGS[config_name]
@@ -94,6 +95,7 @@ def main() -> None:
     # model needs the mesh (same rule the Trainer applies).
     model = ProGen(config=cfg, policy=make_policy(mixed_precision=True),
                    attn_impl=attn_impl, remat=remat,
+                   remat_policy=remat_policy,
                    mesh=mesh if attn_impl == "pallas" else None)
     sample = jnp.zeros((batch, cfg.seq_len), jnp.int32)
 
@@ -175,7 +177,8 @@ def main() -> None:
                     f"{'train' if mode == 'train' else 'fwd+bwd (no optimizer)'}"
                     f" throughput, ProGen-{config_name} "
                     f"(seq_len {cfg.seq_len}, batch {batch}, bf16, "
-                    f"{attn_impl} attn{', remat' if remat else ''}, "
+                    f"{attn_impl} attn"
+                    f"{(', remat:' + remat_policy) if remat else ''}, "
                     f"{n_chips} chip(s))"
                 ),
                 "value": round(tps_chip, 1),

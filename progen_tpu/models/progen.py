@@ -293,12 +293,20 @@ class ProGen(nn.Module):
     ``remat=True`` rematerializes each block in the backward pass
     (``jax.checkpoint`` per layer) — trades ~30% more FLOPs for O(depth)
     less activation memory, the standard TPU HBM trade for the larger
-    configs.
+    configs.  ``remat_policy`` refines the trade:
+
+    * ``"full"`` (default) — save only block boundaries; recompute
+      EVERYTHING in the backward, including the attention and all matmuls;
+    * ``"dots"`` — ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``:
+      matmul outputs are saved, only the cheap elementwise/norm/softmax work
+      is recomputed — most of full-remat's memory win at a fraction of its
+      recompute FLOPs (the right setting when HBM is tight but not critical).
     """
 
     config: ProGenConfig
     policy: Policy = dataclasses.field(default_factory=make_policy)
     remat: bool = False
+    remat_policy: str = "full"  # "full" | "dots"
     attn_impl: str = "xla"  # "xla" | "pallas" (TPU windowed flash kernel)
     # With a mesh whose 'seq' axis is >1, sequence mixing (attention windows,
     # SGU spatial matmul) runs through the explicit context-parallel ops
@@ -340,8 +348,23 @@ class ProGen(nn.Module):
         # kept f32, cast inside apply.
         sin, cos = fixed_pos_embedding(n, cfg.dim_head)
 
-        attn_cls = nn.remat(LocalAttention) if self.remat else LocalAttention
-        ff_cls = nn.remat(FeedForward) if self.remat else FeedForward
+        if self.remat:
+            if self.remat_policy == "full":
+                ckpt_policy = None
+            elif self.remat_policy == "dots":
+                ckpt_policy = (
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                )
+            else:
+                raise ValueError(
+                    f"unknown remat_policy {self.remat_policy!r}; "
+                    "use 'full' or 'dots'"
+                )
+            attn_cls = nn.remat(LocalAttention, policy=ckpt_policy)
+            ff_cls = nn.remat(FeedForward, policy=ckpt_policy)
+        else:
+            attn_cls = LocalAttention
+            ff_cls = FeedForward
 
         for i in range(cfg.depth):
             use_gmlp = cfg.layer_uses_gmlp(i)

@@ -119,3 +119,36 @@ def test_mixed_precision_compute_is_bf16(model_and_params):
     )
     attn_out = intermediates["intermediates"]["attn0"]["__call__"][0]
     assert attn_out.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("policy_name", ["full", "dots"])
+def test_remat_grads_match_no_remat(model_and_params, policy_name):
+    """Rematerialization (either policy) is a memory trade, never a numbers
+    change: loss and grads must match the no-remat model exactly."""
+    _, params = model_and_params
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(1, CFG.num_tokens, (2, CFG.seq_len)))
+
+    def loss_for(model):
+        def f(p):
+            logits = model.apply(p, tokens)
+            return jnp.mean(jax.nn.log_softmax(logits)[..., 3] ** 2)
+        return jax.jit(jax.value_and_grad(f))
+
+    pol = make_policy(False)
+    base = loss_for(ProGen(config=CFG, policy=pol))
+    remat = loss_for(ProGen(config=CFG, policy=pol, remat=True,
+                            remat_policy=policy_name))
+    l0, g0 = base(params)
+    l1, g1 = remat(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_policy_validated():
+    model = ProGen(config=CFG, policy=make_policy(False), remat=True,
+                   remat_policy="everything")
+    with pytest.raises(ValueError, match="remat_policy"):
+        model.init(jax.random.key(0), jnp.zeros((1, CFG.seq_len), jnp.int32))
