@@ -55,12 +55,22 @@ def _rotate_at(x, sin_row, cos_row):
 
 
 def init_caches(config: ProGenConfig, batch_size: int,
-                policy: Policy | None = None) -> dict:
-    """Zero caches for a fresh decode (a plain pytree, scan-friendly)."""
+                policy: Policy | None = None,
+                decode_len: int | None = None) -> dict:
+    """Zero caches for a fresh decode (a plain pytree, scan-friendly).
+
+    ``decode_len``: positions the decode will actually visit (default
+    ``seq_len``).  The attention ring is O(window) regardless; the SGU gate
+    cache — the one seq_len-sized buffer — shrinks to ``decode_len`` rows,
+    so a 200-token sample from a 4096-seq_len config allocates (and
+    contracts per step) 200 rows, not 4096.  Exact because SGU row ``pos``
+    is causally masked to columns ``<= pos < decode_len``.
+    """
     c = config
     pol = policy or make_policy()
     dt = pol.compute_dtype
     ring = 2 * c.window_size
+    n_rows = min(decode_len or c.seq_len, c.seq_len)
     return {
         "attn_prev": [jnp.zeros((batch_size, c.dim), dt) for _ in range(c.depth)],
         "ff_prev": [jnp.zeros((batch_size, c.dim), dt) for _ in range(c.depth)],
@@ -69,7 +79,7 @@ def init_caches(config: ProGenConfig, batch_size: int,
         "v": [jnp.zeros((batch_size, c.heads, ring, c.dim_head), dt)
               for _ in range(c.depth)],
         "sgu_gate": {
-            str(i): jnp.zeros((batch_size, c.seq_len, (c.dim * c.ff_mult) // 2), dt)
+            str(i): jnp.zeros((batch_size, n_rows, (c.dim * c.ff_mult) // 2), dt)
             for i in range(c.depth) if c.layer_uses_gmlp(i)
         },
     }
@@ -140,13 +150,17 @@ class SGUDecode(nn.Module):
         biases = self.param("spatial_biases", nn.initializers.ones, (n, 1),
                             self.policy.param_dtype)
 
+        # the cache may be shorter than seq_len (short-decode fast path);
+        # only weight columns < n_cache can be causally live since pos
+        # stays < n_cache for the whole decode
+        n_cache = gate_cache.shape[1]
         gate_cache = jax.lax.dynamic_update_index_in_dim(
             gate_cache, gate, pos, axis=1
         )
         w_row = jax.lax.dynamic_index_in_dim(
             weights.astype(jnp.float32), pos, axis=0, keepdims=False
-        )  # (n,)
-        causal = (jnp.arange(n) <= pos).astype(jnp.float32)
+        )[:n_cache]
+        causal = (jnp.arange(n_cache) <= pos).astype(jnp.float32)
         w_row = w_row * causal
         mixed = jnp.einsum("bnd,n->bd", gate_cache.astype(jnp.float32), w_row)
         bias_m = jax.lax.dynamic_index_in_dim(

@@ -53,8 +53,10 @@ def make_sampler(config: ProGenConfig, policy: Policy | None = None):
     """Build ``sample(params, key, prime, length, ...)``.
 
     ``prime``: ``(B, P)`` int tokens (already encoded).  ``length`` must be
-    ≤ ``config.seq_len`` (the gMLP caches are seq_len-sized).  Returns
-    ``(B, length)`` sequences, EOS-truncated.
+    ≤ ``config.seq_len`` (the learned (seq_len, seq_len) gMLP weights have
+    no rows past that — true of the reference too).  Short decodes are
+    cheap: every cache and the scan are sized to ``length``, not seq_len.
+    Returns ``(B, length)`` sequences, EOS-truncated.
     """
     policy = policy or make_policy()
     step_model = ProGenDecodeStep(config=config, policy=policy)
@@ -79,7 +81,7 @@ def make_sampler(config: ProGenConfig, policy: Policy | None = None):
 
         seq = jnp.zeros((b, length), jnp.int32)
         seq = jax.lax.dynamic_update_slice(seq, prime.astype(jnp.int32), (0, 0))
-        caches = init_caches(config, b, policy)
+        caches = init_caches(config, b, policy, decode_len=length)
 
         def body(carry, pos):
             seq, caches, key = carry
@@ -113,7 +115,7 @@ def teacher_forced_logits(config: ProGenConfig, params, tokens,
     policy = policy or make_policy()
     step_model = ProGenDecodeStep(config=config, policy=policy)
     b, n = tokens.shape
-    caches = init_caches(config, b, policy)
+    caches = init_caches(config, b, policy, decode_len=n)
 
     def body(caches, pos):
         tok = jax.lax.dynamic_index_in_dim(tokens, pos, axis=1, keepdims=False)

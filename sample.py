@@ -21,7 +21,13 @@ if os.environ.get("JAX_PLATFORMS"):
 @click.option("--top_k", default=25)
 @click.option("--temperature", default=1.0)
 @click.option("--num_samples", default=1, help="decode N sequences in one batch")
-def main(seed, checkpoint_path, prime, top_k, temperature, num_samples):
+@click.option("--seq_len", default=None, type=int,
+              help="decode length (reference sample.py flag); defaults to "
+                   "the model's trained seq_len, capped there (the learned "
+                   "gMLP weights have no rows past it). Short decodes are "
+                   "cheap: caches and the scan are sized to this length.")
+def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
+         seq_len):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -46,7 +52,12 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples):
     store.close()
 
     num_params = sum(x.size for x in jax.tree.leaves(params))
-    seq_len = model_config.seq_len
+    if seq_len is None:
+        seq_len = model_config.seq_len
+    elif seq_len > model_config.seq_len:
+        print(f"capping --seq_len {seq_len} to the model's trained "
+              f"seq_len {model_config.seq_len}")
+        seq_len = model_config.seq_len
     print(f"params: {num_params:,}")
     print(f"sequence length: {seq_len}")
     print(f"trained for {max(meta['next_seq_index'], 0)} sequences")

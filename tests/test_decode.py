@@ -83,6 +83,32 @@ def test_teacher_forced_matches_on_short_prefix_lengths():
                                    rtol=2e-4, atol=2e-4, err_msg=f"L={L}")
 
 
+def test_short_decode_fast_path_is_exact(trained):
+    """Short decodes size the SGU gate cache to the decode length; by
+    causality the first L logits must still match the full-length parallel
+    forward — including through the gMLP layer."""
+    model, params, policy = trained
+    rng = np.random.default_rng(5)
+    full = jnp.asarray(rng.integers(1, CFG.num_tokens, (2, CFG.seq_len)),
+                       jnp.int32)
+    want_full = model.apply(params, full)
+    for L in (6, 12):
+        got = teacher_forced_logits(CFG, params, full[:, :L], policy)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want_full[:, :L]),
+            rtol=2e-4, atol=2e-4, err_msg=f"L={L}")
+
+
+def test_short_decode_caches_are_length_sized():
+    policy = make_policy(False)
+    caches = init_caches(CFG, 1, policy, decode_len=8)
+    gmlp_layer = next(iter(caches["sgu_gate"]))
+    assert caches["sgu_gate"][gmlp_layer].shape[1] == 8
+    # never larger than seq_len even if asked
+    caches = init_caches(CFG, 1, policy, decode_len=10_000)
+    assert caches["sgu_gate"][gmlp_layer].shape[1] == CFG.seq_len
+
+
 def test_sampler_respects_prime_and_length(trained):
     _, params, policy = trained
     sample = make_sampler(CFG, policy)
