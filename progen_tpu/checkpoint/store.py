@@ -147,6 +147,27 @@ class CheckpointStore:
             self._mgr = None
 
 
+def _default_sharding():
+    """Explicit single-device sharding for the unsharded restore path:
+    orbax warns (and is topology-unsafe) when left to read sharding info
+    from the checkpoint's own files."""
+    return jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+
+def _with_shardings(abstract, shardings):
+    if shardings is None:
+        default = _default_sharding()
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=default),
+            abstract,
+        )
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abstract,
+        shardings,
+    )
+
+
 def abstract_params_like(model, sample_tokens, shardings=None):
     """Abstract params pytree for :meth:`CheckpointStore.restore_params`."""
     from progen_tpu.parallel.sharding import unbox
@@ -155,13 +176,7 @@ def abstract_params_like(model, sample_tokens, shardings=None):
         lambda k: unbox(model.init(k, sample_tokens))["params"],
         jax.random.key(0),
     )
-    if shardings is not None:
-        abstract = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            abstract,
-            shardings,
-        )
-    return abstract
+    return _with_shardings(abstract, shardings)
 
 
 def abstract_state_like(fns, key=None):
@@ -169,10 +184,4 @@ def abstract_state_like(fns, key=None):
     :class:`~progen_tpu.train.step.TrainFunctions` bundle."""
     key = key if key is not None else jax.random.key(0)
     abstract = jax.eval_shape(fns.init_state, key)
-    if fns.state_shardings is not None:
-        abstract = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            abstract,
-            fns.state_shardings,
-        )
-    return abstract
+    return _with_shardings(abstract, fns.state_shardings)

@@ -17,9 +17,9 @@ Format contract (reference ``/root/reference/progen_transformer/data.py``):
 TPU/SPMD additions (no counterpart in the single-process reference):
 
 * ``process_count``/``process_index`` shard the RECORD stream across hosts
-  (record-level round-robin, so per-host skip arithmetic stays exact:
-  global ``skip`` maps to ``skip // process_count`` per host — every host
-  must be fed the same global skip);
+  (record-level round-robin, so per-host skip arithmetic stays exact for
+  ANY global cursor: host h skips ``ceil((skip - h) / P)`` of its records —
+  every host must be fed the same global skip);
 * batches come out int32 (TPU-native index dtype) rather than uint16.
 
 TensorFlow is imported lazily and used only for file IO (tf.data never
@@ -199,12 +199,6 @@ def iterator_from_tfrecords_folder(
         seed: int = 0,
     ) -> Iterator[np.ndarray]:
         tf = _tf()
-        if skip % process_count != 0:
-            raise ValueError(
-                f"global skip {skip} must be a multiple of process_count "
-                f"{process_count} (checkpoint next_seq_index is aligned to "
-                "the global batch, which is host-divisible)"
-            )
         ds = tf.data.TFRecordDataset(filenames, compression_type="GZIP")
         if process_count > 1:
             ds = ds.shard(process_count, process_index)
@@ -218,7 +212,20 @@ def iterator_from_tfrecords_folder(
             # (data.py:54-62), which emits a short batch every epoch AND
             # permanently loses the skipped prefix on resume.
             ds = ds.repeat()
-        ds = ds.skip(skip // process_count)
+        # Per-host skip for a GLOBAL record cursor under round-robin
+        # sharding: host h owns records {h, h+P, h+2P, ...}; of the first
+        # `skip` global records it owns ceil((skip - h) / P).  For any
+        # cursor value — aligned or not (an epoch-boundary wrap can leave
+        # next_seq_index % P != 0) — the union of the hosts' next batches
+        # is exactly records [skip, skip + P*batch), so resume stays
+        # record-exact.
+        if process_count > 1:
+            per_host_skip = max(
+                0, -(-(skip - process_index) // process_count)
+            )
+        else:
+            per_host_skip = skip
+        ds = ds.skip(per_host_skip)
         ds = ds.map(
             lambda rec: tf.io.parse_single_example(
                 rec, {"seq": tf.io.FixedLenFeature([], tf.string)}

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from progen_tpu.checkpoint import CheckpointStore, abstract_state_like
+from progen_tpu.parallel.sharding import batch_sharding
 from progen_tpu.core.mesh import Mesh, MeshConfig, make_mesh
 from progen_tpu.core.precision import make_policy
 from progen_tpu.core.rng import KeySeq
@@ -63,6 +64,7 @@ class TrainerConfig:
     strategies: Sequence[str] = ("dp",)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     remat: bool = False
+    remat_policy: str = "full"  # "full" | "dots" (see ProGen.remat_policy)
     attn_impl: str = "xla"  # "xla" | "pallas"
     log_every: int = 10
     sample_top_k: int = 25         # reference hardcodes 25 (train.py:224)
@@ -107,8 +109,8 @@ class Trainer:
             else None
         )
         self.model = ProGen(config=model_config, policy=self.policy,
-                            remat=cfg.remat, attn_impl=cfg.attn_impl,
-                            mesh=cp_mesh)
+                            remat=cfg.remat, remat_policy=cfg.remat_policy,
+                            attn_impl=cfg.attn_impl, mesh=cp_mesh)
         self.lr_schedule = make_lr_schedule(
             cfg.lr_schedule,
             cfg.learning_rate,
@@ -129,11 +131,28 @@ class Trainer:
             self.model, self.optimizer, sample_tokens,
             mesh=self.mesh, strategies=cfg.strategies,
         )
+        self.data_sharding = (
+            batch_sharding(self.mesh) if self.mesh is not None else None
+        )
         self.store = CheckpointStore(checkpoint_path, cfg.checkpoint_keep_n)
         self.tracker = tracker or Tracker(disabled=True)
         self.sampler = make_sampler(model_config, self.policy)
         self.keys = KeySeq(cfg.seed)
         self.meter = ThroughputMeter()
+
+    def _to_device(self, np_batch) -> jax.Array:
+        """Host batch -> device array for the jitted step.
+
+        Multi-process (one controller per host): every host holds only ITS
+        rows of the global batch; ``make_array_from_process_local_data``
+        assembles the global sharded array without any host ever
+        materializing the full batch.  Single process: a plain transfer
+        (jit's in_shardings lay it out)."""
+        if self.mesh is not None and jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                self.data_sharding, np.asarray(np_batch)
+            )
+        return jnp.asarray(np_batch)
 
     # -- state ---------------------------------------------------------------
 
@@ -212,7 +231,7 @@ class Trainer:
                 )
                 for i in range(steps_per_epoch):
                     for _ in range(cfg.grad_accum_every):
-                        batch = jnp.asarray(next(train_it))
+                        batch = self._to_device(next(train_it))
                         state, metrics = self.fns.train_step(state, batch)
                     global_step += 1
                     seq_cursor = (seq_cursor + effective_batch) % total_train
@@ -239,7 +258,7 @@ class Trainer:
                         self._checkpoint(state, seq_cursor)
 
                     if global_step % cfg.validate_every == 0:
-                        vbatch = jnp.asarray(next(valid_it))
+                        vbatch = self._to_device(next(valid_it))
                         vmetrics = self.fns.eval_step(state, vbatch)
                         vloss = float(vmetrics["loss"])
                         self.tracker.log({"valid_loss": vloss}, global_step)
@@ -269,11 +288,32 @@ class Trainer:
 
     def _sample_and_log(self, state, valid_batch, step: int) -> None:
         """In-training sampling (reference train.py:219-228): prime with the
-        first ``prime_length`` tokens of a validation row, decode, log."""
+        first ``prime_length`` tokens of a validation row, decode, log.
+
+        Multi-host: the per-host valid streams are disjoint, so process 0's
+        prime row is broadcast to every host and placed replicated over the
+        global mesh (the sampler then runs as one SPMD program against the
+        globally-sharded params — a host-local prime would be rejected by
+        jit as an incompatible device set)."""
         cfg = self.cfg
-        prime = jnp.asarray(valid_batch[:1, : cfg.prime_length], jnp.int32)
+        prime_np = np.asarray(valid_batch[:1, : cfg.prime_length], np.int32)
+        key = next(self.keys)
+        if self.mesh is not None and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            prime_np = multihost_utils.broadcast_one_to_all(prime_np)
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            prime = jax.make_array_from_process_local_data(repl, prime_np)
+            # KeySeq is seeded identically on every host, so the key VALUE
+            # agrees; re-materialize it replicated over the global mesh.
+            key_data = jax.make_array_from_process_local_data(
+                repl, np.asarray(jax.random.key_data(key)))
+            key = jax.random.wrap_key_data(key_data)
+        else:
+            prime = jnp.asarray(prime_np)
         sampled = self.sampler(
-            {"params": state.params}, next(self.keys), prime,
+            {"params": state.params}, key, prime,
             length=self.model_config.seq_len, top_k=cfg.sample_top_k,
         )
         prime_str = decode_tokens(np.asarray(prime[0]))

@@ -1,0 +1,73 @@
+"""Subprocess worker for the multi-host smoke test.
+
+Runs ONE process of a 2-process ``jax.distributed`` CPU job executing the
+real Trainer.  Spawned by ``tests/test_multihost.py`` — not a test module
+itself (leading underscore keeps pytest collection away).
+
+argv: process_id num_processes port data_dir ckpt_dir runs_dir
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    process_id, num_processes, port = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    )
+    data_dir, ckpt_dir, runs_dir = sys.argv[4], sys.argv[5], sys.argv[6]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes
+    assert jax.local_device_count() == 1  # XLA flag set by the test
+
+    from progen_tpu.core.mesh import MeshConfig
+    from progen_tpu.models import ProGenConfig
+    from progen_tpu.observe import Tracker
+    from progen_tpu.train.trainer import Trainer, TrainerConfig
+
+    model_config = ProGenConfig(
+        num_tokens=256, dim=64, seq_len=64, depth=2, window_size=32,
+        global_mlp_depth=1, heads=2, dim_head=32, ff_mult=2,
+    )
+    cfg = TrainerConfig(
+        seed=7,
+        batch_size=2,               # per-host -> global batch 4
+        grad_accum_every=1,
+        epochs=1,
+        mixed_precision=False,      # f32 so losses compare tightly
+        strategies=("dp",),
+        mesh=MeshConfig(data=num_processes, fsdp=1, tensor=1, seq=1),
+        log_every=1,
+        validate_every=2,
+        sample_every=3,             # exercise SPMD in-training sampling
+        prime_length=8,
+        checkpoint_every=3,
+        max_steps=3,
+    )
+    tracker = Tracker(out_dir=runs_dir, run_id="multihost", use_wandb=False)
+    trainer = Trainer(
+        model_config=model_config, cfg=cfg, data_path=data_dir,
+        checkpoint_path=ckpt_dir, tracker=tracker,
+    )
+    try:
+        result = trainer.run()
+    finally:
+        tracker.finish()
+
+    print(json.dumps({
+        "process_id": process_id,
+        "final_loss": result["loss"],
+        "step": result["step"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Multi-host smoke test: two real ``jax.distributed`` CPU processes run
+the actual Trainer and must agree with a single-process run.
+
+Verifies, end to end (VERDICT r1 item 7):
+
+* ``jax.distributed.initialize`` + a mesh spanning both processes;
+* per-host data sharding (round-robin record split) feeds each host
+  disjoint rows whose union is the single-process global batch;
+* the jitted SPMD train step over process-spanning sharded arrays
+  (``make_array_from_process_local_data``);
+* single-writer tracker logs + a valid orbax checkpoint written
+  cooperatively by both processes;
+* in-training sampling as an SPMD program (broadcast prime, replicated
+  key, globally-sharded params);
+* the loss trajectory matches a single-process run of the same global
+  batch (the union is row-permuted, and batch_loss is a row mean, so the
+  numbers agree to f32 tolerance).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from progen_tpu.data.tfrecord import shard_filename, write_tfrecord
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _make_data(data_dir: Path, n_train: int = 48, n_valid: int = 8) -> None:
+    rng = np.random.default_rng(0)
+    data_dir.mkdir(parents=True)
+    for split, n in (("train", n_train), ("valid", n_valid)):
+        payloads = [
+            b"# " + bytes(rng.integers(65, 91, size=40).tolist())
+            for _ in range(n)
+        ]
+        write_tfrecord(data_dir / shard_filename(0, n, split), payloads)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_trainer_matches_single(tmp_path):
+    data_dir = tmp_path / "data"
+    _make_data(data_dir)
+    port = _free_port()
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # one device per process: the 2-device mesh spans the two PROCESSES
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": str(REPO),
+    }
+    workers = [
+        subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "_multihost_worker.py"),
+             str(i), "2", str(port), str(data_dir),
+             str(tmp_path / "ckpt_mh"), str(tmp_path / "runs_mh")],
+            env=env, cwd=str(REPO),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for w in workers:
+        out, _ = w.communicate(timeout=420)
+        outs.append(out)
+    for i, (w, out) in enumerate(zip(workers, outs)):
+        assert w.returncode == 0, f"worker {i} failed:\n{out}"
+
+    results = {}
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        r = json.loads(line)
+        results[r["process_id"]] = r
+    assert results[0]["step"] == results[1]["step"] == 3
+    # the loss is computed on replicated outputs: both controllers agree
+    assert results[0]["final_loss"] == pytest.approx(
+        results[1]["final_loss"], rel=1e-6)
+
+    # single-writer: exactly process 0's tracker wrote, and only one run dir
+    run_dirs = list((tmp_path / "runs_mh").iterdir())
+    assert [d.name for d in run_dirs] == ["multihost"]
+    metrics = [json.loads(l) for l in
+               (run_dirs[0] / "metrics.jsonl").read_text().splitlines()]
+    mh_losses = {m["step"]: m["loss"] for m in metrics if "loss" in m}
+    assert set(mh_losses) == {1, 2, 3}
+    # the in-training sample at step 3 ran SPMD and process 0 logged it
+    assert (run_dirs[0] / "samples.html").exists()
+
+    # the cooperatively-written checkpoint is valid and restorable
+    from progen_tpu.checkpoint import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "ckpt_mh"))
+    meta = store.restore_meta()
+    store.close()
+    assert meta is not None and meta["train_step"] == 3
+    # global batch 4 x 3 steps consumed
+    assert meta["next_seq_index"] == 12
+
+    # ---- single-process reference run: same seed, same GLOBAL batch ----
+    from progen_tpu.models import ProGenConfig
+    from progen_tpu.observe import Tracker
+    from progen_tpu.train.trainer import Trainer, TrainerConfig
+
+    model_config = ProGenConfig(
+        num_tokens=256, dim=64, seq_len=64, depth=2, window_size=32,
+        global_mlp_depth=1, heads=2, dim_head=32, ff_mult=2,
+    )
+    cfg = TrainerConfig(
+        seed=7, batch_size=4, grad_accum_every=1, epochs=1,
+        mixed_precision=False, log_every=1, validate_every=2,
+        sample_every=10_000, checkpoint_every=3, max_steps=3,
+    )
+    tracker = Tracker(out_dir=str(tmp_path / "runs_sp"), run_id="single",
+                      use_wandb=False)
+    trainer = Trainer(
+        model_config=model_config, cfg=cfg, data_path=str(data_dir),
+        checkpoint_path=str(tmp_path / "ckpt_sp"), tracker=tracker,
+        use_mesh=False,
+    )
+    try:
+        trainer.run()
+    finally:
+        tracker.finish()
+    sp_metrics = [json.loads(l) for l in
+                  (tmp_path / "runs_sp" / "single" / "metrics.jsonl")
+                  .read_text().splitlines()]
+    sp_losses = {m["step"]: m["loss"] for m in sp_metrics if "loss" in m}
+
+    # per-host round-robin rows union to a row-permutation of the
+    # single-process batch; the row-mean loss must agree step by step
+    for step in (1, 2, 3):
+        assert mh_losses[step] == pytest.approx(sp_losses[step], rel=2e-4), (
+            step, mh_losses, sp_losses)

@@ -68,6 +68,10 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
               help="mesh axis sizes data,fsdp,tensor,seq (-1 = remaining)")
 @click.option("--remat", default=False, is_flag=True,
               help="rematerialize blocks in backward (saves HBM)")
+@click.option("--remat_policy", default="full",
+              type=click.Choice(["full", "dots"]),
+              help="full: recompute everything; dots: save matmul outputs, "
+                   "recompute only elementwise work")
 @click.option("--attn_impl", default="xla", type=click.Choice(["xla", "pallas"]),
               help="windowed attention implementation")
 @click.option("--log_every", default=10)
@@ -136,6 +140,7 @@ def main(**flags):
         strategies=tuple(flags["strategies"].split(",")),
         mesh=mesh_cfg,
         remat=flags["remat"],
+        remat_policy=flags["remat_policy"],
         attn_impl=flags["attn_impl"],
         log_every=flags["log_every"],
         max_steps=flags["max_steps"],
