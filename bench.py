@@ -18,7 +18,8 @@ PROGEN_BENCH_ATTN ("xla" | "pallas", default "pallas" — measured faster
 at every config, see benchmarks/attention.md),
 PROGEN_BENCH_REMAT ("0"/"1", default on for base/large/xl),
 PROGEN_BENCH_PEAK_TFLOPS (FALLBACK for unrecognized device kinds only —
-known TPU generations auto-resolve from PEAK_TFLOPS, e.g. v4 -> 275),
+known TPU generations auto-resolve from
+progen_tpu.observe.PEAK_BF16_TFLOPS, e.g. v4 -> 275),
 PROGEN_BENCH_MODE ("train" | "fwdbwd", default "train") — "fwdbwd" times
 loss+gradients WITHOUT optimizer state, the only way to run the 1.2B+
 configs on a single 16GB v5e chip (f32 Adam moments alone exceed HBM;
@@ -38,14 +39,6 @@ import numpy as np
 
 NORTH_STAR_TOKENS_PER_SEC_PER_CHIP = 40_000.0
 
-# bf16 peak by device kind; fallback taken from PROGEN_BENCH_PEAK_TFLOPS
-PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5p": 459.0,
-}
-
 
 def synthetic_uniref_batch(rng: np.random.Generator, batch: int, seq_len: int):
     """Uniref50-shaped rows: '# ' + uppercase residues, +1 offset, BOS col,
@@ -59,21 +52,12 @@ def synthetic_uniref_batch(rng: np.random.Generator, batch: int, seq_len: int):
     return out
 
 
-def model_flops_per_token(cfg, num_params: int) -> float:
-    """Training FLOPs (fwd+bwd) per token: the standard 6N for every dense
-    parameter (the SGU spatial weights are parameters, so 6N covers them)
-    plus the windowed-attention score/value matmuls, which touch 2*wsz keys
-    per query: fwd 8*wsz*inner FLOPs/token/layer, x3 with the backward."""
-    inner = cfg.heads * cfg.dim_head
-    attn = 24.0 * cfg.window_size * inner * cfg.depth
-    return 6.0 * num_params + attn
-
-
 def main() -> None:
     from progen_tpu.core.mesh import MeshConfig, make_mesh
     from progen_tpu.core.precision import make_policy
     from progen_tpu.models import ProGen
     from progen_tpu.models.configs import CONFIGS
+    from progen_tpu.observe import PEAK_BF16_TFLOPS, model_flops_per_token
     from progen_tpu.train import make_optimizer, make_train_functions
 
     config_name = os.environ.get("PROGEN_BENCH_CONFIG", "small")
@@ -165,7 +149,7 @@ def main() -> None:
 
     kind = jax.devices()[0].device_kind
     peak = float(os.environ.get(
-        "PROGEN_BENCH_PEAK_TFLOPS", PEAK_TFLOPS.get(kind, 197.0)
+        "PROGEN_BENCH_PEAK_TFLOPS", PEAK_BF16_TFLOPS.get(kind, 197.0)
     )) * 1e12
     mfu = model_flops_per_token(cfg, num_params) * tps_chip / peak
 

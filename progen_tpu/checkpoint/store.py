@@ -60,6 +60,16 @@ class CheckpointStore:
     def latest_step(self) -> int | None:
         return self._manager().latest_step()
 
+    def reached_preemption(self, step: int) -> bool:
+        """Cross-host-consistent preemption check (orbax rides the JAX
+        coordination service, so every host agrees on the answer — a
+        per-host signal flag would deadlock the cooperative save).  False
+        when no distributed runtime / no preemption notice exists."""
+        try:
+            return bool(self._manager().reached_preemption(step))
+        except Exception:
+            return False
+
     def save(
         self,
         step: int,

@@ -1,4 +1,18 @@
+from progen_tpu.observe.flops import (
+    PEAK_BF16_TFLOPS,
+    mfu,
+    model_flops_per_token,
+    peak_flops_per_chip,
+)
 from progen_tpu.observe.meter import ThroughputMeter, profile_trace
 from progen_tpu.observe.tracker import Tracker
 
-__all__ = ["ThroughputMeter", "profile_trace", "Tracker"]
+__all__ = [
+    "PEAK_BF16_TFLOPS",
+    "mfu",
+    "model_flops_per_token",
+    "peak_flops_per_chip",
+    "ThroughputMeter",
+    "profile_trace",
+    "Tracker",
+]

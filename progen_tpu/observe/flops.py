@@ -1,0 +1,47 @@
+"""Model-FLOPs accounting and MFU estimation.
+
+BASELINE.md's headline metric is tokens/sec/chip, which is meaningless
+across model scales; MFU (model FLOPs utilization) normalizes it against
+the chip's peak so throughput claims stay honest (the reference publishes
+no numbers at all — SURVEY.md §6).  Shared by ``bench.py`` and the
+training loop's live metrics.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# bf16 peak by jax device_kind; extend as new generations appear.
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def model_flops_per_token(cfg, num_params: int) -> float:
+    """Training FLOPs (fwd+bwd) per token: the standard 6N for every dense
+    parameter (the SGU spatial weights are parameters, so 6N covers them)
+    plus the windowed-attention score/value matmuls, which touch 2*wsz keys
+    per query: fwd 8*wsz*inner FLOPs/token/layer, x3 with the backward."""
+    inner = cfg.heads * cfg.dim_head
+    attn = 24.0 * cfg.window_size * inner * cfg.depth
+    return 6.0 * num_params + attn
+
+
+def peak_flops_per_chip(device=None) -> float | None:
+    """Peak bf16 FLOP/s of the local accelerator, or None off-TPU /
+    unknown kind (callers skip MFU then)."""
+    device = device or jax.devices()[0]
+    tflops = PEAK_BF16_TFLOPS.get(device.device_kind)
+    return None if tflops is None else tflops * 1e12
+
+
+def mfu(tokens_per_sec_per_chip: float, flops_per_token: float,
+        peak: float | None) -> float | None:
+    if peak is None or peak <= 0:
+        return None
+    return flops_per_token * tokens_per_sec_per_chip / peak

@@ -123,8 +123,13 @@ def make_train_functions(
     def eval_step(state: TrainState, batch):
         ids, labels = batch[:, :-1], batch[:, 1:]
         logits = apply_model(state.params, ids)
+        # all-zero rows are padding added to square off a final partial
+        # eval batch; callers drop them via this mask (a real collated row
+        # always has content after the BOS column)
+        real_rows = jnp.any(batch != 0, axis=1)
         return {"loss": batch_loss(logits, labels),
-                "per_row_loss": cross_entropy(logits, labels)}
+                "per_row_loss": cross_entropy(logits, labels),
+                "real_rows": real_rows}
 
     if mesh is not None:
         train_step = jax.jit(
@@ -136,6 +141,9 @@ def make_train_functions(
         eval_step = jax.jit(
             eval_step,
             in_shardings=(state_shardings, data_sharding),
+            # replicated outputs: every host must be able to fetch the
+            # full per-row metrics (multi-process full-validation eval)
+            out_shardings=repl,
         )
     else:
         train_step = jax.jit(train_step, donate_argnums=(0,))

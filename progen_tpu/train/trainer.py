@@ -33,7 +33,14 @@ from progen_tpu.core.rng import KeySeq
 from progen_tpu.data import decode_tokens, iterator_from_tfrecords_folder
 from progen_tpu.decode import make_sampler
 from progen_tpu.models import ProGen, ProGenConfig
-from progen_tpu.observe import ThroughputMeter, Tracker, profile_trace
+from progen_tpu.observe import (
+    ThroughputMeter,
+    Tracker,
+    mfu,
+    model_flops_per_token,
+    peak_flops_per_chip,
+    profile_trace,
+)
 from progen_tpu.train.optimizer import make_optimizer
 from progen_tpu.train.schedule import lr_at, make_lr_schedule
 from progen_tpu.train.step import make_train_functions
@@ -139,6 +146,23 @@ class Trainer:
         self.sampler = make_sampler(model_config, self.policy)
         self.keys = KeySeq(cfg.seed)
         self.meter = ThroughputMeter()
+        # Preemption safety (TPU VMs are preemptible; the reference's only
+        # fault story is its periodic checkpoint): single-process runs get
+        # a SIGTERM handler that requests a checkpoint at the next step
+        # boundary; multi-host runs use orbax's coordination-service-backed
+        # reached_preemption so all hosts agree (a per-host signal flag
+        # would desync the cooperative save).
+        self._preempt_requested = False
+        if jax.process_count() == 1:
+            import signal
+
+            try:
+                signal.signal(signal.SIGTERM, self._request_preempt_checkpoint)
+            except ValueError:
+                pass  # not the main thread (e.g. under a test runner)
+
+    def _request_preempt_checkpoint(self, signum=None, frame=None) -> None:
+        self._preempt_requested = True
 
     def _to_device(self, np_batch) -> jax.Array:
         """Host batch -> device array for the jitted step.
@@ -209,6 +233,8 @@ class Trainer:
         )
 
         num_params = sum(x.size for x in jax.tree.leaves(state.params))
+        flops_per_token = model_flops_per_token(self.model_config, num_params)
+        peak = peak_flops_per_chip()  # None off-TPU -> mfu not logged
         if process_index == 0:
             print(f"params: {num_params:,}")
             print(f"sequence length: {seq_len}")
@@ -250,6 +276,9 @@ class Trainer:
                         tps = self.meter.tokens_per_sec_per_chip
                         if tps is not None:
                             log["tokens_per_sec_per_chip"] = tps
+                            util = mfu(tps, flops_per_token, peak)
+                            if util is not None:
+                                log["mfu"] = util
                         self.tracker.log(log, global_step)
                         if process_index == 0:
                             print(f"step {global_step} loss: {last_loss:.4f}")
@@ -268,11 +297,82 @@ class Trainer:
                     if global_step % cfg.sample_every == 0:
                         self._sample_and_log(state, next(valid_it), global_step)
 
+                    if (self._preempt_requested
+                            or self.store.reached_preemption(global_step)):
+                        self._checkpoint(state, seq_cursor)
+                        if process_index == 0:
+                            print(
+                                f"preemption checkpoint at step {global_step}; "
+                                "exiting (resume restarts here)"
+                            )
+                        return {"state": state, "loss": last_loss,
+                                "step": global_step, "preempted": True}
+
                     if cfg.max_steps is not None and global_step >= cfg.max_steps:
                         self._checkpoint(state, seq_cursor)
-                        return {"state": state, "loss": last_loss,
-                                "step": global_step}
-        return {"state": state, "loss": last_loss, "step": global_step}
+                        return self._finish(state, last_loss, global_step)
+        return self._finish(state, last_loss, global_step)
+
+    def _finish(self, state, last_loss, global_step: int) -> dict[str, Any]:
+        """Full-validation eval loss (BASELINE.md's second metric) at the
+        end of training, logged and returned."""
+        valid_loss = self.evaluate(state)
+        if valid_loss is not None:
+            self.tracker.log({"full_valid_loss": valid_loss}, global_step)
+            if jax.process_index() == 0:
+                print(f"full valid loss: {valid_loss:.4f}")
+        return {"state": state, "loss": last_loss, "step": global_step,
+                "valid_loss": valid_loss}
+
+    def evaluate(self, state, max_batches: int | None = None) -> float | None:
+        """Mean per-row loss over the ENTIRE validation split, one pass —
+        the honest "eval loss" number for BASELINE.md (the in-loop
+        ``validate_every`` probe times a single batch, matching the
+        reference ``train.py:213-217``).
+
+        The final partial batch is zero-padded up to the static batch shape
+        (no jit retrace) and the pad rows are masked out via the step's
+        ``real_rows`` output, so the mean is exact over all records.
+        Multi-host: every host feeds its shard; outputs are replicated, so
+        all hosts return the same number.
+        """
+        cfg = self.cfg
+        total_valid, get_valid = iterator_from_tfrecords_folder(
+            self.data_path, "valid")
+        if total_valid == 0:
+            return None
+        process_count = jax.process_count()
+        it = get_valid(
+            seq_len=self.model_config.seq_len, batch_size=cfg.batch_size,
+            loop=False, process_count=process_count,
+            process_index=jax.process_index(),
+        )
+        # every host must run the SAME number of eval_step calls (SPMD);
+        # round-robin sharding leaves hosts with up to 1 extra record, so
+        # the count comes from the largest shard, and exhausted hosts feed
+        # all-pad batches (masked out by real_rows).
+        width = self.model_config.seq_len + 1
+        max_host_records = -(-total_valid // process_count)
+        n_batches = -(-max_host_records // cfg.batch_size)
+        if max_batches is not None:
+            n_batches = min(n_batches, max_batches)
+        loss_sum, rows = 0.0, 0
+        for _ in range(n_batches):
+            np_batch = next(it, None)
+            if np_batch is None:
+                np_batch = np.zeros((cfg.batch_size, width), np.int32)
+            elif np_batch.shape[0] < cfg.batch_size:
+                pad = np.zeros(
+                    (cfg.batch_size - np_batch.shape[0], np_batch.shape[1]),
+                    np_batch.dtype,
+                )
+                np_batch = np.concatenate([np_batch, pad])
+            metrics = self.fns.eval_step(state, self._to_device(np_batch))
+            per_row = np.asarray(metrics["per_row_loss"])
+            real = np.asarray(metrics["real_rows"])
+            loss_sum += float((per_row * real).sum())
+            rows += int(real.sum())
+        return loss_sum / rows if rows else None
 
     # -- hooks ---------------------------------------------------------------
 

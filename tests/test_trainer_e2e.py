@@ -101,6 +101,39 @@ def test_ragged_corpus_through_sharded_trainer(tmp_path, devices8):
     t.store.close()
 
 
+def test_full_validation_eval_is_exact(tmp_path):
+    """Trainer.evaluate must equal the per-record mean CE over the WHOLE
+    valid split — including when the last batch is partial (3 % 2 != 0) —
+    with pad rows masked out, not averaged in."""
+    from progen_tpu.data import iterator_from_tfrecords_folder
+    from progen_tpu.train.loss import cross_entropy
+
+    d = tmp_path / "eval_data"
+    d.mkdir()
+    rng = np.random.default_rng(3)
+    mk = lambda: bytes(rng.integers(65, 90, rng.integers(6, 14)))
+    write_tfrecord(d / shard_filename(0, 4, "train"), [mk() for _ in range(4)])
+    write_tfrecord(d / shard_filename(0, 3, "valid"), [mk() for _ in range(3)])
+
+    cfg = TrainerConfig(batch_size=2, mixed_precision=False, max_steps=1)
+    t = Trainer(model_config=CFG, cfg=cfg, data_path=str(d),
+                checkpoint_path=str(tmp_path / "eval_ckpt"), use_mesh=False)
+    state = t.fns.init_state(jax.random.key(0))
+    got = t.evaluate(state)
+
+    # oracle: per-row CE over each valid record individually
+    _, it_fn = iterator_from_tfrecords_folder(str(d), "valid")
+    rows = np.concatenate(list(it_fn(seq_len=CFG.seq_len, batch_size=1)))
+    assert rows.shape[0] == 3
+    per_row = []
+    for r in rows:
+        batch = jnp.asarray(r[None])
+        logits = t.model.apply({"params": state.params}, batch[:, :-1])
+        per_row.append(float(cross_entropy(logits, batch[:, 1:])[0]))
+    assert got == pytest.approx(np.mean(per_row), rel=1e-5)
+    t.store.close()
+
+
 def test_trainer_rejects_config_mismatch(data_dir, tmp_path):
     ckpt = tmp_path / "ckpts2"
     t1 = _trainer(data_dir, ckpt, tmp_path / "runs2", max_steps=1)
@@ -113,6 +146,26 @@ def test_trainer_rejects_config_mismatch(data_dir, tmp_path):
                  checkpoint_path=str(ckpt), use_mesh=False)
     with pytest.raises(ValueError, match="model config differs"):
         t2.restore_or_init()
+    t2.store.close()
+
+
+def test_preemption_checkpoints_and_resumes(data_dir, tmp_path):
+    """A preemption notice (SIGTERM flag) makes the trainer checkpoint at
+    the next step boundary and exit; a fresh trainer resumes from it."""
+    ckpt = tmp_path / "preempt_ckpt"
+    t = _trainer(data_dir, ckpt, tmp_path / "preempt_runs", max_steps=50)
+    t._request_preempt_checkpoint()  # what the SIGTERM handler does
+    out = t.run()
+    assert out.get("preempted") is True
+    assert out["step"] == 1  # stopped at the first boundary
+    t.store.close()
+
+    t2 = _trainer(data_dir, ckpt, tmp_path / "preempt_runs", max_steps=2)
+    state, start_seq, _ = t2.restore_or_init()
+    assert int(state.step) == 1 * 2  # grad_accum 2 micro-steps
+    assert start_seq > 0
+    out2 = t2.run()
+    assert out2["step"] == 2 and not out2.get("preempted")
     t2.store.close()
 
 
