@@ -1,0 +1,31 @@
+"""Migration CLI: reference Haiku checkpoint pickle -> native store.
+
+A reference (`mattfeng/progen`) user keeps their trained weights when
+switching to this framework:
+
+    python convert_checkpoint.py --pkl ./ckpts/ckpt_1646000000.pkl \\
+        --checkpoint_path ./ckpts_tpu
+
+then `train.py --checkpoint_path ./ckpts_tpu` resumes (fresh Adam moments,
+same data cursor) and `sample.py --checkpoint_path ./ckpts_tpu` decodes.
+"""
+
+import click
+
+
+@click.command()
+@click.option("--pkl", required=True,
+              help="reference ckpt_{unixtime}.pkl (cloudpickle package)")
+@click.option("--checkpoint_path", default="./ckpts",
+              help="native checkpoint store to write")
+def main(pkl, checkpoint_path):
+    from progen_tpu.compat import convert_reference_checkpoint
+
+    meta = convert_reference_checkpoint(pkl, checkpoint_path)
+    print(f"converted {meta['num_params']:,} params "
+          f"-> {checkpoint_path} (resume at sequence "
+          f"{meta['next_seq_index']}, run_id {meta['run_id']})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+from progen_tpu.compat.reference import (
+    convert_reference_checkpoint,
+    convert_reference_params,
+    reference_key_map,
+)
+
+__all__ = [
+    "convert_reference_checkpoint",
+    "convert_reference_params",
+    "reference_key_map",
+]

@@ -1,0 +1,151 @@
+"""Reference-checkpoint migration: Haiku pickles -> this framework.
+
+The reference stores cloudpickled packages ``{next_seq_index, params,
+optim_state, model_config, run_id}`` (``/root/reference/train.py:202-208``,
+``checkpoint.py:30-31``) with Haiku-named parameters.  A reference user
+switching to this framework keeps their trained weights: this module maps
+every Haiku parameter onto the flax tree and writes a native (orbax)
+checkpoint.
+
+Haiku naming (verified empirically against dm-haiku's auto-naming rules
+for the reference's module structure, ``progen.py:50-233``):
+
+=============================================  ===========================
+reference (module | param)                     this framework
+=============================================  ===========================
+pro_gen_base/~/embed | embeddings              embed/embedding
+.../attn{i}/~/layer_norm | scale               attn{i}/norm/scale
+.../attn{i}/~/linear | w                       attn{i}/to_qkv/kernel
+.../attn{i}/~/linear_1 | w, b                  attn{i}/to_out/kernel, bias
+.../ff{i}/~/layer_norm | scale                 ff{i}/norm/scale
+.../ff{i}/~/linear | w, b                      ff{i}/proj_in/kernel, bias
+.../ff{i}/~/linear_1 | w, b                    ff{i}/proj_out/kernel, bias
+.../ff{i}/~/sgu | spatial_weights, _biases     ff{i}/sgu/spatial_weights, _biases
+.../ff{i}/~/sgu/~/layer_norm | scale           ff{i}/sgu/norm/scale
+.../ff{i}/~/sgu/~/linear | w, b                ff{i}/sgu/proj_out/kernel, bias
+pro_gen_base/~/layer_norm | scale              norm_out/scale
+pro_gen_base/~/linear | w, b                   to_logits/kernel, bias
+=============================================  ===========================
+
+No transposes anywhere: Haiku ``Linear.w`` and flax ``Dense.kernel`` are
+both ``(in, out)``; embeddings are both ``(vocab, dim)``; the SGU spatial
+weights use the same ``einsum('n d, m n -> m d')`` convention (oracle-
+tested on both sides).
+
+The reference's optimizer state (an old-optax ``apply_every`` chain) is
+NOT portable and is not converted; resuming re-initializes Adam moments.
+``next_seq_index`` and ``run_id`` carry over.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Mapping
+
+import numpy as np
+
+_REF_ROOT = "pro_gen_base"
+
+
+def reference_key_map(config) -> dict[tuple[str, str], tuple[str, ...]]:
+    """``(haiku_module, haiku_param) -> flax path`` for every parameter of
+    ``config`` (a ProGenConfig)."""
+    m: dict[tuple[str, str], tuple[str, ...]] = {
+        (f"{_REF_ROOT}/~/embed", "embeddings"): ("embed", "embedding"),
+        (f"{_REF_ROOT}/~/layer_norm", "scale"): ("norm_out", "scale"),
+        (f"{_REF_ROOT}/~/linear", "w"): ("to_logits", "kernel"),
+        (f"{_REF_ROOT}/~/linear", "b"): ("to_logits", "bias"),
+    }
+    for i in range(config.depth):
+        a = f"{_REF_ROOT}/~/attn{i}/~"
+        f = f"{_REF_ROOT}/~/ff{i}/~"
+        m[(f"{a}/layer_norm", "scale")] = (f"attn{i}", "norm", "scale")
+        m[(f"{a}/linear", "w")] = (f"attn{i}", "to_qkv", "kernel")
+        m[(f"{a}/linear_1", "w")] = (f"attn{i}", "to_out", "kernel")
+        m[(f"{a}/linear_1", "b")] = (f"attn{i}", "to_out", "bias")
+        m[(f"{f}/layer_norm", "scale")] = (f"ff{i}", "norm", "scale")
+        m[(f"{f}/linear", "w")] = (f"ff{i}", "proj_in", "kernel")
+        m[(f"{f}/linear", "b")] = (f"ff{i}", "proj_in", "bias")
+        m[(f"{f}/linear_1", "w")] = (f"ff{i}", "proj_out", "kernel")
+        m[(f"{f}/linear_1", "b")] = (f"ff{i}", "proj_out", "bias")
+        if config.layer_uses_gmlp(i):
+            sgu = f"{_REF_ROOT}/~/ff{i}/~/sgu"
+            m[(sgu, "spatial_weights")] = (f"ff{i}", "sgu", "spatial_weights")
+            m[(sgu, "spatial_biases")] = (f"ff{i}", "sgu", "spatial_biases")
+            m[(f"{sgu}/~/layer_norm", "scale")] = (
+                f"ff{i}", "sgu", "norm", "scale")
+            m[(f"{sgu}/~/linear", "w")] = (f"ff{i}", "sgu", "proj_out", "kernel")
+            m[(f"{sgu}/~/linear", "b")] = (f"ff{i}", "sgu", "proj_out", "bias")
+    return m
+
+
+def convert_reference_params(ref_params: Mapping[str, Mapping[str, Any]],
+                             config) -> dict:
+    """Haiku two-level param dict -> nested flax ``params`` tree (f32).
+
+    Raises on any missing or unexpected reference parameter so silent
+    partial conversions cannot happen.
+    """
+    key_map = reference_key_map(config)
+    flat_ref = {
+        (mod, name): np.asarray(v, dtype=np.float32)
+        for mod, sub in ref_params.items()
+        for name, v in sub.items()
+    }
+    missing = set(key_map) - set(flat_ref)
+    extra = set(flat_ref) - set(key_map)
+    if missing or extra:
+        raise ValueError(
+            "reference params do not match the config's parameter set:\n"
+            f"  missing from pickle: {sorted(missing)}\n"
+            f"  unexpected in pickle: {sorted(extra)}"
+        )
+
+    out: dict = {}
+    for ref_key, path in key_map.items():
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = flat_ref[ref_key]
+    return out
+
+
+def convert_reference_checkpoint(pkl_path: str, checkpoint_path: str) -> dict:
+    """Convert a reference ``ckpt_{time}.pkl`` into a native checkpoint
+    store at ``checkpoint_path``.  Returns the written metadata.
+
+    The optimizer state is freshly initialized (see module docstring);
+    training resumes at the stored ``next_seq_index`` with step 0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from progen_tpu.checkpoint import CheckpointStore
+    from progen_tpu.models import ProGenConfig
+    from progen_tpu.train.optimizer import make_optimizer
+    from progen_tpu.train.step import TrainState
+
+    with open(pkl_path, "rb") as fh:
+        package = pickle.load(fh)
+
+    config = ProGenConfig.from_dict(package["model_config"])
+    params = convert_reference_params(package["params"], config)
+    params = jax.tree.map(jnp.asarray, params)
+    opt_state = make_optimizer().init(params)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt_state)
+
+    store = CheckpointStore(checkpoint_path)
+    store.save(
+        0, state,
+        next_seq_index=int(package.get("next_seq_index", 0)),
+        model_config=config.to_dict(),
+        run_id=package.get("run_id"),
+    )
+    store.close()
+    return {
+        "model_config": config.to_dict(),
+        "next_seq_index": int(package.get("next_seq_index", 0)),
+        "run_id": package.get("run_id"),
+        "num_params": sum(x.size for x in jax.tree.leaves(params)),
+    }
