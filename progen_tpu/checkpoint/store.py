@@ -64,10 +64,18 @@ class CheckpointStore:
         """Cross-host-consistent preemption check (orbax rides the JAX
         coordination service, so every host agrees on the answer — a
         per-host signal flag would deadlock the cooperative save).  False
-        when no distributed runtime / no preemption notice exists."""
+        when no distributed runtime / no preemption notice exists.
+
+        A failing check is reported ONCE rather than silently swallowed
+        forever — otherwise a misconfigured coordination service would
+        quietly disable the very protection this exists to provide."""
         try:
             return bool(self._manager().reached_preemption(step))
-        except Exception:
+        except Exception as e:
+            if not getattr(self, "_preemption_check_warned", False):
+                self._preemption_check_warned = True
+                print(f"warning: preemption check unavailable ({e!r}); "
+                      "relying on periodic checkpoints only")
             return False
 
     def save(

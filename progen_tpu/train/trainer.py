@@ -246,6 +246,7 @@ class Trainer:
         global_step = int(state.step) // cfg.grad_accum_every
         seq_cursor = start_seq_index
         last_loss = None
+        pending_tokens = 0
 
         with profile_trace(cfg.profile_dir):
             for epoch in range(1, cfg.epochs + 1):
@@ -261,10 +262,20 @@ class Trainer:
                         state, metrics = self.fns.train_step(state, batch)
                     global_step += 1
                     seq_cursor = (seq_cursor + effective_batch) % total_train
-                    self.meter.tick(effective_batch * seq_len)
+                    pending_tokens += effective_batch * seq_len
 
+                    will_hook = (
+                        global_step % cfg.checkpoint_every == 0
+                        or global_step % cfg.validate_every == 0
+                        or global_step % cfg.sample_every == 0
+                    )
                     if global_step % cfg.log_every == 0:
+                        # float() blocks until the step chain is executed —
+                        # the only trustworthy sync point, so the meter
+                        # ticks HERE with the tokens since the last sync
                         last_loss = float(metrics["loss"])
+                        self.meter.tick(pending_tokens)
+                        pending_tokens = 0
                         log = {
                             "loss": last_loss,
                             "grad_norm": float(metrics["grad_norm"]),
@@ -283,8 +294,19 @@ class Trainer:
                         if process_index == 0:
                             print(f"step {global_step} loss: {last_loss:.4f}")
 
+                    if will_hook and pending_tokens:
+                        # hook cadences need not align with log_every: sync
+                        # and tick BEFORE the hooks so their wall time is
+                        # never rated against these steps' tokens (and the
+                        # hook's own blocking never absorbs them)
+                        float(metrics["grad_norm"])
+                        self.meter.tick(pending_tokens)
+                        pending_tokens = 0
+
+                    hooks_ran = False
                     if global_step % cfg.checkpoint_every == 0:
                         self._checkpoint(state, seq_cursor)
+                        hooks_ran = True
 
                     if global_step % cfg.validate_every == 0:
                         vbatch = self._to_device(next(valid_it))
@@ -293,9 +315,16 @@ class Trainer:
                         self.tracker.log({"valid_loss": vloss}, global_step)
                         if process_index == 0:
                             print(f"valid_loss: {vloss:.4f}")
+                        hooks_ran = True
 
                     if global_step % cfg.sample_every == 0:
                         self._sample_and_log(state, next(valid_it), global_step)
+                        hooks_ran = True
+
+                    if hooks_ran:
+                        # hook time (eval/sampling/checkpoint IO) is not
+                        # training time; drop it from the meter's window
+                        self.meter.rebase()
 
                     if (self._preempt_requested
                             or self.store.reached_preemption(global_step)):

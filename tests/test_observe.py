@@ -1,0 +1,94 @@
+"""Observability tests: throughput meter semantics, FLOPs/MFU accounting,
+tracker JSONL sink."""
+
+import json
+import time
+
+import pytest
+
+from progen_tpu.models import ProGenConfig
+from progen_tpu.observe import (
+    PEAK_BF16_TFLOPS,
+    ThroughputMeter,
+    Tracker,
+    mfu,
+    model_flops_per_token,
+    peak_flops_per_chip,
+)
+
+
+def test_meter_needs_two_sync_points():
+    m = ThroughputMeter()
+    assert m.tokens_per_sec is None
+    m.tick(1000)
+    assert m.tokens_per_sec is None  # one tick = no interval yet
+
+
+def test_meter_rates_tokens_between_ticks():
+    m = ThroughputMeter()
+    m.tick(0)          # sync point opening the window
+    time.sleep(0.05)
+    m.tick(5000)       # 5000 tokens over ~50ms
+    tps = m.tokens_per_sec
+    assert tps == pytest.approx(5000 / 0.05, rel=0.5)
+
+
+def test_meter_first_interval_tokens_excluded():
+    """The first tick's token count is NOT rated (no interval covers it) —
+    this is what keeps compile time out of the steady-state number."""
+    m = ThroughputMeter()
+    m.tick(10_000_000)  # huge "tokens" attached to the opening tick
+    time.sleep(0.02)
+    m.tick(1000)
+    assert m.tokens_per_sec < 1_000_000  # only the 1000 tokens are rated
+
+
+def test_meter_window_slides():
+    m = ThroughputMeter(window=2)
+    for _ in range(10):
+        m.tick(100)
+    assert len(m._intervals) == 2  # only the last `window` intervals kept
+
+
+def test_model_flops_per_token_dominated_by_6n():
+    cfg = ProGenConfig(dim=1024, depth=12, heads=8, dim_head=128,
+                       window_size=256, seq_len=1024)
+    n = 200_000_000
+    f = model_flops_per_token(cfg, n)
+    assert f > 6 * n  # attention adds on top
+    assert f < 6.5 * n  # ...but stays a small correction at this scale
+
+
+def test_mfu_math_and_unknown_peak():
+    assert mfu(40_000, 6.0 * 1.2e9, 275e12) == pytest.approx(1.047, rel=1e-2)
+    assert mfu(40_000, 6.0 * 1.2e9, None) is None
+    assert "TPU v4" in PEAK_BF16_TFLOPS
+    # CPU test runner: unknown device kind -> None (MFU simply not logged)
+    assert peak_flops_per_chip() is None
+
+
+def test_tracker_jsonl_sink(tmp_path):
+    tr = Tracker(out_dir=str(tmp_path), run_id="obs", use_wandb=False)
+    tr.log({"loss": 1.5, "mfu": 0.5}, step=3)
+    tr.log_sample("PRIME", "SAMPLED", step=3)
+    tr.finish()
+    rows = [json.loads(l) for l in
+            (tmp_path / "obs" / "metrics.jsonl").read_text().splitlines()]
+    assert rows == [{"step": 3, "loss": 1.5, "mfu": 0.5,
+                     "time": rows[0]["time"]}]
+    html = (tmp_path / "obs" / "samples.html").read_text()
+    assert "PRIME" in html and "SAMPLED" in html
+
+
+def test_meter_rebase_excludes_hook_time():
+    m = ThroughputMeter()
+    m.tick(0)
+    time.sleep(0.02)
+    m.tick(1000)       # ~50k tok/s of real train time
+    time.sleep(0.08)   # a "sampling hook" stall
+    m.rebase()         # trainer calls this after hooks
+    time.sleep(0.02)
+    m.tick(1000)
+    # without rebase the 80ms stall would drag the rate to ~2000/0.12;
+    # with it both intervals are ~20ms of train time
+    assert m.tokens_per_sec == pytest.approx(2000 / 0.04, rel=0.5)
