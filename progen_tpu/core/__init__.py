@@ -1,8 +1,10 @@
+from progen_tpu.core.cache import enable_compilation_cache
 from progen_tpu.core.mesh import MESH_AXES, MeshConfig, make_mesh, single_device_mesh
 from progen_tpu.core.precision import Policy, make_policy
 from progen_tpu.core.rng import KeySeq
 
 __all__ = [
+    "enable_compilation_cache",
     "MESH_AXES",
     "MeshConfig",
     "make_mesh",

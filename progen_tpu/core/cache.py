@@ -1,0 +1,40 @@
+"""Persistent XLA compilation cache.
+
+A fresh process pays 20-40s to compile the train step and MINUTES for the
+1024-step decode scan (measured ~4 min for ProGen-small's sampler on a
+v5e).  JAX can persist compiled executables to disk; enabling it makes
+restarts, resume-after-preemption and the sample CLI start in seconds.
+
+Off by default inside the library (libraries should not write to disk
+unasked); the CLIs call :func:`enable_compilation_cache` at startup.
+``PROGEN_COMPILE_CACHE=0`` disables; ``PROGEN_COMPILE_CACHE=<dir>``
+relocates.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(default_dir: str = "~/.cache/progen_tpu/xla") -> str | None:
+    """Turn on JAX's on-disk compilation cache (honoring the env knob).
+
+    Returns the cache dir, or None when disabled.  Safe to call multiple
+    times and before any backend initialization.
+    """
+    knob = os.environ.get("PROGEN_COMPILE_CACHE", "")
+    if knob == "0":
+        return None
+    cache_dir = os.path.expanduser(knob or default_dir)
+
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything that took meaningful compile time; tiny
+        # programs are cheaper to recompile than to hash+read
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        return None  # unwritable dir / unsupported backend: run uncached
+    return cache_dir

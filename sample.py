@@ -32,6 +32,10 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
     import jax.numpy as jnp
     import numpy as np
 
+    from progen_tpu.core.cache import enable_compilation_cache
+
+    enable_compilation_cache()  # the decode scan is minutes of compile
+
     from progen_tpu.checkpoint import CheckpointStore, abstract_params_like
     from progen_tpu.core.precision import make_policy
     from progen_tpu.core.rng import KeySeq

@@ -85,6 +85,9 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--data_parallel", default=False, is_flag=True, hidden=True)
 @click.option("--seq_len", default=None, type=int, hidden=True)
 def main(**flags):
+    from progen_tpu.core.cache import enable_compilation_cache
+
+    enable_compilation_cache()  # restarts/resume hit the on-disk XLA cache
     if flags["distributed"]:
         from progen_tpu.core.mesh import initialize_distributed
 
