@@ -13,11 +13,12 @@ the four mesh axes from ``progen_tpu/core/mesh.py``:
 * ``tp``    — megatron-style: qkv/mlp column-parallel, out/proj row-parallel
               over 'tensor'; activations sharded on heads/mlp.
 * ``sp``    — activations sharded along the sequence over 'seq'
-              (context parallelism).  Under plain pjit XLA inserts generic
-              collectives for the window structure; the explicit
-              halo-exchange path (``progen_tpu/parallel/context.py``,
-              shard_map + ppermute) is the optimized route.  The SGU
-              spatial weights shard row-wise.
+              (context parallelism).  The model forward routes sequence
+              mixing through the explicit halo-exchange ops
+              (``progen_tpu/parallel/context.py``, shard_map + ppermute)
+              whenever the mesh's seq axis is >1 — GSPMD never invents
+              collectives for the window structure.  The SGU spatial
+              weights shard row-wise.
 
 Strategies compose: rules are merged left-to-right (first occurrence of a
 logical axis wins), with ONE exception — ``sp`` is always merged first,

@@ -62,6 +62,11 @@ class TrainerConfig:
     checkpoint_keep_n: int = 500
     prime_length: int = 25
     mixed_precision: bool = True
+    # tf.data sliding-window shuffle over the (pre-shuffled-at-prep) record
+    # stream; 0 = off, matching the reference, whose only shuffle happens
+    # at data prep (generate_data.py:119). With a buffer, resume-by-skip
+    # restarts at the right cursor but records near it re-order.
+    shuffle_buffer: int = 0
     # LR schedule (reference is constant-lr; warmup/decay needed >=1.2B)
     lr_schedule: str = "constant"  # "constant" | "cosine" | "linear"
     warmup_steps: int = 0
@@ -226,6 +231,7 @@ class Trainer:
         train_it = get_train(
             seq_len=seq_len, batch_size=cfg.batch_size, skip=start_seq_index,
             loop=True, process_count=process_count, process_index=process_index,
+            shuffle_buffer=cfg.shuffle_buffer, seed=cfg.seed,
         )
         valid_it = get_valid(
             seq_len=seq_len, batch_size=cfg.batch_size, loop=True,

@@ -4,7 +4,12 @@ Runs ONE process of a 2-process ``jax.distributed`` CPU job executing the
 real Trainer.  Spawned by ``tests/test_multihost.py`` — not a test module
 itself (leading underscore keeps pytest collection away).
 
-argv: process_id num_processes port data_dir ckpt_dir runs_dir
+argv: process_id num_processes port data_dir ckpt_dir runs_dir [strategy]
+
+``strategy`` (default ``dp``): ``dp`` maps the 2-process mesh onto the
+data axis (params replicated); ``fsdp`` onto the fsdp axis (params,
+grads AND optimizer state sharded across the two processes — the
+cooperative orbax save then writes genuinely distributed arrays).
 """
 
 import json
@@ -16,6 +21,7 @@ def main() -> None:
         int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     )
     data_dir, ckpt_dir, runs_dir = sys.argv[4], sys.argv[5], sys.argv[6]
+    strategy = sys.argv[7] if len(sys.argv) > 7 else "dp"
 
     import jax
 
@@ -43,8 +49,12 @@ def main() -> None:
         grad_accum_every=1,
         epochs=1,
         mixed_precision=False,      # f32 so losses compare tightly
-        strategies=("dp",),
-        mesh=MeshConfig(data=num_processes, fsdp=1, tensor=1, seq=1),
+        strategies=(strategy,),
+        mesh=(
+            MeshConfig(data=num_processes, fsdp=1, tensor=1, seq=1)
+            if strategy == "dp"
+            else MeshConfig(data=1, fsdp=num_processes, tensor=1, seq=1)
+        ),
         log_every=1,
         validate_every=2,
         sample_every=3,             # exercise SPMD in-training sampling

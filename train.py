@@ -58,6 +58,9 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--prime_length", default=25)
 @click.option("--mixed_precision", default=False, is_flag=True)
 @click.option("--data_path", default="./train_data")
+@click.option("--shuffle_buffer", default=0,
+              help="sliding-window record shuffle (0 = off, reference "
+                   "behavior; data is already shuffled at prep)")
 @click.option("--wandb_off", default=False, is_flag=True)
 @click.option("--wandb_project_name", default="progen-training")
 @click.option("--new", default=False, is_flag=True)
@@ -140,6 +143,7 @@ def main(**flags):
         checkpoint_keep_n=flags["checkpoint_keep_n"],
         prime_length=flags["prime_length"],
         mixed_precision=flags["mixed_precision"],
+        shuffle_buffer=flags["shuffle_buffer"],
         strategies=tuple(flags["strategies"].split(",")),
         mesh=mesh_cfg,
         remat=flags["remat"],
