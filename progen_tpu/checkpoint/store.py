@@ -44,20 +44,24 @@ class CheckpointStore:
             options = ocp.CheckpointManagerOptions(
                 max_to_keep=self._keep_last_n,
                 create=True,
-                enable_async_checkpointing=False,
+                # async: save() returns once the arrays are copied to host;
+                # the tensorstore write proceeds in the background off the
+                # training critical path (orbax's device->host copy is
+                # blocking, so donated step buffers are safe to reuse).
+                # Readers call wait_until_finished() first.
+                enable_async_checkpointing=True,
             )
             self._mgr = ocp.CheckpointManager(self._path, options=options)
         return self._mgr
 
     def reset(self) -> None:
         """Delete every checkpoint (reference 'reset' semantics)."""
-        if self._mgr is not None:
-            self._mgr.close()
-            self._mgr = None
+        self.close()
         if self._path.exists():
             self._path.rmtree()
 
     def latest_step(self) -> int | None:
+        """Newest saved step, INCLUDING an async save still in flight."""
         return self._manager().latest_step()
 
     def reached_preemption(self, step: int) -> bool:
@@ -86,18 +90,37 @@ class CheckpointStore:
         next_seq_index: int,
         model_config: dict,
         run_id: str | None = None,
-    ) -> None:
+        overwrite: bool = False,
+    ) -> bool:
         """``state`` is a TrainState; params and opt_state are stored as
         SEPARATE items so inference can restore params without knowing the
         optimizer structure (the reference's single pickle forces sample.py
-        to deserialize optimizer moments it never uses)."""
+        to deserialize optimizer moments it never uses).
+
+        Saving a step that already exists in the store is a no-op returning
+        False: the trainer's exit/preemption save can land on the same step
+        as the periodic hook (max_steps a multiple of checkpoint_every),
+        and within one training run the state at a given step is unique, so
+        the second write would be wasted IO that some orbax versions reject
+        (StepAlreadyExists).  Callers whose data DOES change at the same
+        step — e.g. re-converting a reference pickle into an existing
+        store — pass ``overwrite=True`` to replace it instead.
+
+        Returns True when a save was actually issued.  The write completes
+        in the background; readers and :meth:`close` wait for it.
+        """
+        mgr = self._manager()
+        if step == mgr.latest_step():
+            if not overwrite:
+                return False
+            mgr.wait_until_finished()
+            mgr.delete(step)
         meta = {
             "next_seq_index": int(next_seq_index),
             "model_config": model_config,
             "run_id": run_id,
             "train_step": int(state.step),
         }
-        mgr = self._manager()
         mgr.save(
             step,
             args=ocp.args.Composite(
@@ -106,12 +129,18 @@ class CheckpointStore:
                 meta=ocp.args.JsonSave(meta),
             ),
         )
-        mgr.wait_until_finished()
+        return True
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed to storage."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
 
     def restore_meta(self, step: int | None = None) -> dict | None:
         """Metadata only — enough to rebuild the model/config before the
         (potentially sharded) state restore."""
         mgr = self._manager()
+        mgr.wait_until_finished()
         step = step if step is not None else mgr.latest_step()
         if step is None:
             return None
@@ -126,6 +155,7 @@ class CheckpointStore:
         ``jax.eval_shape``.
         """
         mgr = self._manager()
+        mgr.wait_until_finished()
         step = step if step is not None else mgr.latest_step()
         if step is None:
             return None
@@ -142,6 +172,7 @@ class CheckpointStore:
         :func:`abstract_state_like`.
         """
         mgr = self._manager()
+        mgr.wait_until_finished()
         step = step if step is not None else mgr.latest_step()
         if step is None:
             return None
@@ -161,6 +192,7 @@ class CheckpointStore:
 
     def close(self) -> None:
         if self._mgr is not None:
+            self._mgr.wait_until_finished()
             self._mgr.close()
             self._mgr = None
 

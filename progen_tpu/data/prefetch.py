@@ -1,0 +1,104 @@
+"""Double-buffered host->device input feed.
+
+The reference feeds the accelerator synchronously — ``next(train_dataset)``
+then the jitted call, every micro-step (``/root/reference/train.py:191-193``)
+— so the device idles while the host runs tf.data + the NumPy collate and
+the PCIe/tunnel transfer.  Measured on this framework's 500-step v5e run,
+that serialization costs ~10% of steady-state throughput
+(``runs/90b685bbc4d5``: 76.7k tokens/sec fed synchronously vs 85.3k for
+``bench.py`` on device-resident batches).
+
+:class:`DevicePrefetcher` moves the feed off the critical path: a daemon
+thread pulls host batches and STARTS their device transfer (JAX transfers
+are async — the returned array is a future) while the current step
+executes, keeping ``depth`` batches in flight.  The training loop's
+``next()`` then usually returns a batch whose transfer already completed.
+
+Thread-safety: the worker calls only ``next(iterator)`` and ``to_device``
+(``jax.device_put``/``make_array_from_process_local_data``), both safe off
+the main thread; all jitted-step dispatch stays on the caller's thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+
+class _End:
+    pass
+
+
+class _Raised:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetcher:
+    """Wrap ``iterator`` so device transfers overlap step execution.
+
+    ``to_device``: host batch -> device array (its transfer may be async).
+    ``depth``: batches buffered ahead (2 = classic double buffering; more
+    only helps when the host feed is bursty).
+    """
+
+    def __init__(
+        self,
+        iterator: Iterator[Any],
+        to_device: Callable[[Any], Any],
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._iterator = iterator
+        self._to_device = to_device
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, name="progen-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = next(self._iterator)
+                except StopIteration:
+                    self._put(_End())
+                    return
+                self._put(self._to_device(batch))
+        except BaseException as e:  # surfaced on the consumer thread
+            self._put(_Raised(e))
+
+    def _put(self, item) -> None:
+        # bounded put that gives up when the consumer is shutting down
+        # (otherwise a full queue would wedge the daemon thread forever)
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if isinstance(item, _End):
+            raise StopIteration
+        if isinstance(item, _Raised):
+            raise item.exc
+        return item
+
+    def close(self) -> None:
+        """Stop the worker and drop buffered batches (idempotent)."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)

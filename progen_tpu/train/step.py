@@ -88,11 +88,14 @@ def make_train_functions(
         data_sharding = None
         repl = None
 
-    def init_state(key) -> TrainState:
-        fn = lambda k: unbox(init_boxed(k))
-        if mesh is not None:
-            return jax.jit(fn, out_shardings=state_shardings)(key)
-        return jax.jit(fn)(key)
+    # a real jitted function (not a closure re-jitting per call) so callers
+    # can AOT-compile it (.lower) — multi-process launchers stagger compiles
+    # through the persistent cache that way
+    _init_fn = lambda key: unbox(init_boxed(key))
+    if mesh is not None:
+        init_state = jax.jit(_init_fn, out_shardings=state_shardings)
+    else:
+        init_state = jax.jit(_init_fn)
 
     def apply_model(params, ids):
         # Activate the logical-axis rules (and the mesh, which

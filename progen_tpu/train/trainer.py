@@ -31,6 +31,7 @@ from progen_tpu.core.mesh import Mesh, MeshConfig, make_mesh
 from progen_tpu.core.precision import make_policy
 from progen_tpu.core.rng import KeySeq
 from progen_tpu.data import decode_tokens, iterator_from_tfrecords_folder
+from progen_tpu.data.prefetch import DevicePrefetcher
 from progen_tpu.decode import make_sampler
 from progen_tpu.models import ProGen, ProGenConfig
 from progen_tpu.observe import (
@@ -41,6 +42,8 @@ from progen_tpu.observe import (
     peak_flops_per_chip,
     profile_trace,
 )
+from progen_tpu.train.memory import check_fits, device_hbm_bytes
+from progen_tpu.train.memory import plan as memory_plan
 from progen_tpu.train.optimizer import make_optimizer
 from progen_tpu.train.schedule import lr_at, make_lr_schedule
 from progen_tpu.train.step import make_train_functions
@@ -76,8 +79,19 @@ class TrainerConfig:
     strategies: Sequence[str] = ("dp",)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     remat: bool = False
-    remat_policy: str = "full"  # "full" | "dots" (see ProGen.remat_policy)
+    remat_policy: str = "full"  # "full" | "dots" | "attn" (ProGen.remat_policy)
     attn_impl: str = "xla"  # "xla" | "pallas"
+    # input-feed double buffering: batches transferred to device ahead of
+    # the step that consumes them (0 = synchronous reference-style feed)
+    prefetch_depth: int = 2
+    # checkpoint without stalling training: snapshot the state on-device
+    # (one extra state-sized HBM copy) and run the device->host fetch +
+    # write in a background thread.  The fetch is the dominant cost on
+    # slow host links (measured 350s+ for 2.4 GB on the tunneled v5e —
+    # orbax's async mode only backgrounds the DISK write, its
+    # device->host copy blocks by design).  Disable when HBM headroom
+    # cannot afford the snapshot copy.
+    background_checkpoint: bool = True
     log_every: int = 10
     sample_top_k: int = 25         # reference hardcodes 25 (train.py:224)
     profile_dir: str | None = None
@@ -136,6 +150,31 @@ class Trainer:
             max_grad_norm=cfg.max_grad_norm,
             grad_accum_every=cfg.grad_accum_every,
         )
+        # fail fast on configurations that cannot fit the chip — the
+        # planner is calibrated to ~1% of XLA's buffer assignment
+        # (progen_tpu/train/memory.py), so this replaces a many-minute
+        # compile ending in RESOURCE_EXHAUSTED with an instant, actionable
+        # error.  PROGEN_SKIP_MEMORY_CHECK=1 overrides.
+        import os as _os
+
+        if _os.environ.get("PROGEN_SKIP_MEMORY_CHECK") != "1":
+            self.memory_plan = memory_plan(
+                model_config,
+                batch_size=cfg.batch_size * jax.process_count(),
+                mesh_shape=dict(self.mesh.shape) if self.mesh else None,
+                strategies=cfg.strategies,
+                remat=cfg.remat,
+                remat_policy=cfg.remat_policy,
+                attn_impl=cfg.attn_impl,
+                mixed_precision=cfg.mixed_precision,
+                grad_accum_every=cfg.grad_accum_every,
+                checkpoint_snapshot=(cfg.background_checkpoint
+                                     and jax.process_count() == 1),
+            )
+            err = check_fits(self.memory_plan, device_hbm_bytes())
+            if err is not None:
+                raise ValueError(err)
+
         sample_tokens = jnp.zeros(
             (cfg.batch_size, model_config.seq_len), jnp.int32
         )
@@ -148,7 +187,16 @@ class Trainer:
         )
         self.store = CheckpointStore(checkpoint_path, cfg.checkpoint_keep_n)
         self.tracker = tracker or Tracker(disabled=True)
-        self.sampler = make_sampler(model_config, self.policy)
+        # in-training sampling runs against the params IN their training
+        # shardings — they are never gathered to one chip
+        self.sampler = make_sampler(
+            model_config, self.policy, mesh=self.mesh,
+            strategies=cfg.strategies,
+            params_shardings=(
+                self.fns.state_shardings.params
+                if self.fns.state_shardings is not None else None
+            ),
+        )
         self.keys = KeySeq(cfg.seed)
         self.meter = ThroughputMeter()
         # Preemption safety (TPU VMs are preemptible; the reference's only
@@ -158,6 +206,7 @@ class Trainer:
         # reached_preemption so all hosts agree (a per-host signal flag
         # would desync the cooperative save).
         self._preempt_requested = False
+        self._ckpt_thread = None
         if jax.process_count() == 1:
             import signal
 
@@ -182,6 +231,51 @@ class Trainer:
                 self.data_sharding, np.asarray(np_batch)
             )
         return jnp.asarray(np_batch)
+
+    def _warm_compiles(self, state) -> None:
+        """AOT-compile every jitted program the loop will call, BEFORE the
+        throughput meter starts — the decode scan alone is minutes of
+        compile cold, and paying it mid-loop stalls training (measured: a
+        ~5.5-minute sampler compile at the first sample_every hook of the
+        round-3 run).  Only active when the persistent XLA cache is on
+        (the CLIs enable it): ``lower().compile()`` populates the on-disk
+        cache the later jit call reads, but without that cache the warm
+        work could not be reused and would just double compile time."""
+        try:
+            if not jax.config.jax_compilation_cache_dir:
+                return
+        except AttributeError:
+            return
+        cfg = self.cfg
+
+        def abstract(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    jnp.shape(x), x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                tree,
+            )
+
+        st = abstract(state)
+        batch = jax.ShapeDtypeStruct(
+            (cfg.batch_size, self.model_config.seq_len + 1), jnp.int32
+        )
+        prime = jax.ShapeDtypeStruct((1, cfg.prime_length), jnp.int32)
+        programs = [
+            ("train_step", lambda: self.fns.train_step.lower(st, batch)),
+            ("eval_step", lambda: self.fns.eval_step.lower(st, batch)),
+            ("sampler", lambda: self.sampler.lower(
+                {"params": st.params}, jax.random.key(0), prime,
+                length=self.model_config.seq_len, top_k=cfg.sample_top_k,
+            )),
+        ]
+        for name, lower in programs:
+            try:
+                lower().compile()
+            except Exception as e:
+                # warming is an optimization; the loop compiles on demand
+                if jax.process_index() == 0:
+                    print(f"warning: {name} precompile failed ({e!r})")
 
     # -- state ---------------------------------------------------------------
 
@@ -233,14 +327,16 @@ class Trainer:
             loop=True, process_count=process_count, process_index=process_index,
             shuffle_buffer=cfg.shuffle_buffer, seed=cfg.seed,
         )
+        if cfg.prefetch_depth > 0:
+            train_it = DevicePrefetcher(
+                train_it, self._to_device, depth=cfg.prefetch_depth
+            )
         valid_it = get_valid(
             seq_len=seq_len, batch_size=cfg.batch_size, loop=True,
             process_count=process_count, process_index=process_index,
         )
 
         num_params = sum(x.size for x in jax.tree.leaves(state.params))
-        flops_per_token = model_flops_per_token(self.model_config, num_params)
-        peak = peak_flops_per_chip()  # None off-TPU -> mfu not logged
         if process_index == 0:
             print(f"params: {num_params:,}")
             print(f"sequence length: {seq_len}")
@@ -254,6 +350,34 @@ class Trainer:
         last_loss = None
         pending_tokens = 0
 
+        self._warm_compiles(state)
+
+        try:
+            return self._run_loop(
+                state, train_it, valid_it, total_train, start_seq_index,
+                effective_batch, global_step, seq_cursor, last_loss,
+                pending_tokens,
+            )
+        finally:
+            if isinstance(train_it, DevicePrefetcher):
+                train_it.close()
+            # an exception/KeyboardInterrupt must not kill the daemon
+            # checkpoint thread mid-write and lose the last save
+            self._join_checkpoint_thread()
+            self.store.wait_until_finished()
+
+    def _run_loop(self, state, train_it, valid_it, total_train,
+                  start_seq_index, effective_batch, global_step, seq_cursor,
+                  last_loss, pending_tokens):
+        cfg = self.cfg
+        seq_len = self.model_config.seq_len
+        process_index = jax.process_index()
+        num_params = sum(x.size for x in jax.tree.leaves(state.params))
+        flops_per_token = model_flops_per_token(self.model_config, num_params)
+        peak = peak_flops_per_chip()  # None off-TPU -> mfu not logged
+        # the prefetcher already returns device arrays
+        prefetched = isinstance(train_it, DevicePrefetcher)
+
         with profile_trace(cfg.profile_dir):
             for epoch in range(1, cfg.epochs + 1):
                 if process_index == 0:
@@ -264,7 +388,8 @@ class Trainer:
                 )
                 for i in range(steps_per_epoch):
                     for _ in range(cfg.grad_accum_every):
-                        batch = self._to_device(next(train_it))
+                        batch = (next(train_it) if prefetched
+                                 else self._to_device(next(train_it)))
                         state, metrics = self.fns.train_step(state, batch)
                     global_step += 1
                     seq_cursor = (seq_cursor + effective_batch) % total_train
@@ -334,7 +459,9 @@ class Trainer:
 
                     if (self._preempt_requested
                             or self.store.reached_preemption(global_step)):
-                        self._checkpoint(state, seq_cursor)
+                        # the process exits right after: the save must
+                        # fully commit before we let it
+                        self._checkpoint(state, seq_cursor, wait=True)
                         if process_index == 0:
                             print(
                                 f"preemption checkpoint at step {global_step}; "
@@ -344,13 +471,15 @@ class Trainer:
                                 "step": global_step, "preempted": True}
 
                     if cfg.max_steps is not None and global_step >= cfg.max_steps:
-                        self._checkpoint(state, seq_cursor)
+                        self._checkpoint(state, seq_cursor, wait=True)
                         return self._finish(state, last_loss, global_step)
         return self._finish(state, last_loss, global_step)
 
     def _finish(self, state, last_loss, global_step: int) -> dict[str, Any]:
         """Full-validation eval loss (BASELINE.md's second metric) at the
         end of training, logged and returned."""
+        self._join_checkpoint_thread()
+        self.store.wait_until_finished()  # commit any in-flight async save
         valid_loss = self.evaluate(state)
         if valid_loss is not None:
             self.tracker.log({"full_valid_loss": valid_loss}, global_step)
@@ -411,15 +540,70 @@ class Trainer:
 
     # -- hooks ---------------------------------------------------------------
 
-    def _checkpoint(self, state, next_seq_index: int) -> None:
-        self.store.save(
-            int(state.step), state,
-            next_seq_index=next_seq_index,
-            model_config=self.model_config.to_dict(),
-            run_id=self.tracker.run_id,
+    def _join_checkpoint_thread(self) -> None:
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join()
+            self._ckpt_thread = None
+
+    def _checkpoint(self, state, next_seq_index: int, wait: bool = False) -> None:
+        step = int(state.step)
+        run_id = self.tracker.run_id
+        model_config = self.model_config.to_dict()
+
+        def do_save(snapshot) -> None:
+            # save() skips steps already in the store, so the
+            # exit/preemption save after a same-step periodic hook costs
+            # nothing
+            saved = self.store.save(
+                step, snapshot,
+                next_seq_index=next_seq_index,
+                model_config=model_config,
+                run_id=run_id,
+            )
+            if saved and jax.process_index() == 0:
+                print(
+                    f"checkpoint to start at sequence index of {next_seq_index}"
+                )
+
+        if not self.cfg.background_checkpoint or jax.process_count() > 1:
+            # multi-host: the cooperative orbax save is a collective —
+            # every host must enter it in lockstep, so keep it on the
+            # main thread
+            do_save(state)
+            if wait:
+                self.store.wait_until_finished()
+            return
+
+        # one save in flight at a time (bounds the extra HBM to one
+        # state-sized snapshot and keeps store calls single-threaded).
+        # A PERIODIC save that lands while the previous one is still
+        # draining is SKIPPED, not queued: on slow host links the fetch
+        # (~300s for 2.4 GB on the tunneled v5e) can exceed the
+        # checkpoint cadence, and blocking training to wait would
+        # reintroduce the very stall this path removes — you cannot
+        # durably checkpoint faster than the link drains.  Exit and
+        # preemption saves (wait=True) always join and write.
+        if self._ckpt_thread is not None and self._ckpt_thread.is_alive():
+            if not wait:
+                if jax.process_index() == 0:
+                    print(f"checkpoint at step {step} skipped: previous "
+                          "save still writing")
+                return
+        self._join_checkpoint_thread()
+        # on-device copy: O(ms), and donation of `state` by the next
+        # train_step cannot invalidate it (XLA sequences the copy before
+        # the donated buffers are reused)
+        snapshot = jax.tree.map(jnp.copy, state)
+        import threading
+
+        self._ckpt_thread = threading.Thread(
+            target=do_save, args=(snapshot,), name="progen-checkpoint",
+            daemon=True,
         )
-        if jax.process_index() == 0:
-            print(f"checkpoint to start at sequence index of {next_seq_index}")
+        self._ckpt_thread.start()
+        if wait:
+            self._join_checkpoint_thread()
+            self.store.wait_until_finished()
 
     def _sample_and_log(self, state, valid_batch, step: int) -> None:
         """In-training sampling (reference train.py:219-228): prime with the

@@ -62,9 +62,44 @@ def test_keep_last_n_prunes(tmp_path):
         store.save(step, state, next_seq_index=step * 10,
                    model_config=CFG.to_dict())
     assert store.latest_step() == 4
+    # saves are async: pruning and the final write commit in the background
+    store.wait_until_finished()
     steps = sorted(int(p.name) for p in (tmp_path / "ckpts").iterdir()
                    if p.name.isdigit())
     assert steps == [3, 4]
+    store.close()
+
+
+def test_duplicate_step_save_is_skipped(tmp_path):
+    """The exit/preemption checkpoint can land on the same step as the
+    periodic hook (max_steps a multiple of checkpoint_every); the second
+    save must be a no-op, not wasted IO or an orbax StepAlreadyExists."""
+    fns = _setup()
+    state = fns.init_state(jax.random.key(0))
+    store = CheckpointStore(str(tmp_path / "ckpts"))
+    assert store.save(3, state, next_seq_index=30,
+                      model_config=CFG.to_dict()) is True
+    assert store.save(3, state, next_seq_index=30,
+                      model_config=CFG.to_dict()) is False
+    assert store.latest_step() == 3
+    store.close()
+
+
+def test_overwrite_replaces_same_step(tmp_path):
+    """Re-converting a pickle into an existing store must replace the
+    step's contents, not silently keep stale weights."""
+    fns = _setup()
+    state = fns.init_state(jax.random.key(0))
+    store = CheckpointStore(str(tmp_path / "ckpts"))
+    store.save(0, state, next_seq_index=1, model_config=CFG.to_dict())
+
+    bumped = type(state)(step=state.step, opt_state=state.opt_state,
+                         params=jax.tree.map(lambda x: x + 1.0, state.params))
+    assert store.save(0, bumped, next_seq_index=2,
+                      model_config=CFG.to_dict(), overwrite=True) is True
+    assert store.restore_meta()["next_seq_index"] == 2
+    restored = store.restore_state(abstract_state_like(fns))
+    _trees_equal(bumped.params, restored.params)
     store.close()
 
 

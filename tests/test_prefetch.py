@@ -1,0 +1,67 @@
+"""DevicePrefetcher: ordering, exhaustion, error propagation, shutdown."""
+
+import time
+
+import numpy as np
+import pytest
+
+from progen_tpu.data.prefetch import DevicePrefetcher
+
+
+def test_preserves_order_and_transform():
+    batches = [np.full((2, 3), i) for i in range(10)]
+    pf = DevicePrefetcher(iter(batches), lambda b: b + 1, depth=2)
+    out = list(pf)
+    assert len(out) == 10
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(b, batches[i] + 1)
+
+
+def test_stopiteration_propagates():
+    pf = DevicePrefetcher(iter([1, 2]), lambda x: x, depth=2)
+    assert next(pf) == 1
+    assert next(pf) == 2
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_iterator_error_raised_on_consumer_thread():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    pf = DevicePrefetcher(gen(), lambda x: x, depth=2)
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+
+
+def test_close_unblocks_worker_on_full_queue():
+    def gen():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pf = DevicePrefetcher(gen(), lambda x: x, depth=1)
+    assert next(pf) == 0
+    pf.close()  # worker blocked on a full queue must exit promptly
+    assert not pf._thread.is_alive()
+
+
+def test_overlap_actually_buffers_ahead():
+    produced = []
+
+    def gen():
+        for i in range(4):
+            produced.append(i)
+            yield i
+
+    pf = DevicePrefetcher(gen(), lambda x: x, depth=2)
+    deadline = time.monotonic() + 5.0
+    # without consuming anything, the worker should pull depth batches
+    # (one waiting in the queue slot(s), one blocked in _put)
+    while len(produced) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(produced) >= 2
+    assert list(pf) == [0, 1, 2, 3]

@@ -178,3 +178,31 @@ def test_cli_help_runs(script):
     )
     assert out.returncode == 0, out.stderr
     assert "--checkpoint_path" in out.stdout
+
+
+def test_background_checkpoint_skips_when_save_in_flight(data_dir, tmp_path):
+    """Periodic saves must never queue behind a slow in-flight save (on
+    slow host links the fetch can exceed the checkpoint cadence); only
+    wait=True (exit/preemption) joins and always writes."""
+    import threading
+    import time as _time
+
+    t = _trainer(data_dir, tmp_path / "ck", tmp_path / "runs", max_steps=1)
+    state = t.fns.init_state(jax.random.key(0))
+    calls = []
+    release = threading.Event()
+
+    def slow_save(step, snapshot, **kw):
+        calls.append(step)
+        release.wait(timeout=10)
+        return True
+
+    t.store.save = slow_save
+    t._checkpoint(state, 10)                 # starts background save
+    _time.sleep(0.1)
+    t._checkpoint(state, 20)                 # in flight -> skipped
+    assert calls == [0]
+    release.set()
+    t._checkpoint(state, 30, wait=True)      # joins, then writes
+    assert calls == [0, 0]
+    t.store.close()
