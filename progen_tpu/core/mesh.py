@@ -42,6 +42,25 @@ class MeshConfig:
     tensor: int = 1
     seq: int = 1
 
+    @classmethod
+    def parse(cls, spec: str) -> "MeshConfig":
+        """Parse a CLI mesh spec ``"data,fsdp,tensor,seq"`` (e.g.
+        ``"-1,1,1,1"``; ``-1`` = absorb remaining devices)."""
+        parts = spec.split(",")
+        if len(parts) != 4:
+            raise ValueError(
+                f"mesh spec {spec!r} must have exactly 4 comma-separated "
+                "sizes: data,fsdp,tensor,seq (e.g. '-1,1,1,1')"
+            )
+        try:
+            sizes = [int(p) for p in parts]
+        except ValueError as e:
+            raise ValueError(
+                f"mesh spec {spec!r}: every size must be an integer "
+                "(data,fsdp,tensor,seq)"
+            ) from e
+        return cls(*sizes)
+
     def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
         sizes = [self.data, self.fsdp, self.tensor, self.seq]
         wild = [i for i, s in enumerate(sizes) if s == -1]

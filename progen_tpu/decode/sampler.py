@@ -19,10 +19,14 @@ BOS/pad counts as the first).  Structural differences, both conscious:
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
+from typing import Sequence
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from progen_tpu.core.precision import Policy, make_policy
 from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
@@ -49,7 +53,34 @@ def truncate_after_eos(seq, pad_id: int = 0):
     return seq * (~after)
 
 
-def make_sampler(config: ProGenConfig, policy: Policy | None = None):
+def _constrain_caches(caches, mesh: Mesh, strategies: Sequence[str]):
+    """Pin the decode caches' layouts over the mesh.
+
+    Only tensor parallelism shards real decode state: the k/v rings split
+    on heads and the SGU gate cache on its channel half, matching the tp
+    rule table (``parallel/sharding.py``) so the per-step attention and
+    gate contractions stay local to each tensor shard.  Everything else
+    (tiny per-block carries) replicates — decode batches are small and
+    fsdp's win is the PARAMS staying sharded, which they do via
+    ``params_shardings``.
+    """
+    if "tp" not in strategies or mesh.shape.get("tensor", 1) <= 1:
+        return caches
+    wsc = jax.lax.with_sharding_constraint
+    kv = NamedSharding(mesh, PartitionSpec(None, "tensor", None, None))
+    gate = NamedSharding(mesh, PartitionSpec(None, None, "tensor"))
+    return {
+        **caches,
+        "k": [wsc(x, kv) for x in caches["k"]],
+        "v": [wsc(x, kv) for x in caches["v"]],
+        "sgu_gate": {k: wsc(v, gate) for k, v in caches["sgu_gate"].items()},
+    }
+
+
+def make_sampler(config: ProGenConfig, policy: Policy | None = None,
+                 mesh: Mesh | None = None,
+                 strategies: Sequence[str] = ("dp",),
+                 params_shardings=None):
     """Build ``sample(params, key, prime, length, ...)``.
 
     ``prime``: ``(B, P)`` int tokens (already encoded).  ``length`` must be
@@ -57,11 +88,41 @@ def make_sampler(config: ProGenConfig, policy: Policy | None = None):
     no rows past that — true of the reference too).  Short decodes are
     cheap: every cache and the scan are sized to ``length``, not seq_len.
     Returns ``(B, length)`` sequences, EOS-truncated.
+
+    Mesh-aware decode (BASELINE.md's XL row is "fully-sharded params +
+    generation"): pass ``mesh`` (+ ``strategies`` and the params'
+    ``params_shardings``, e.g. ``TrainFunctions.state_shardings.params``)
+    and the decode runs as one SPMD program — params STAY in their
+    training shardings (never gathered to one chip), tp shards the per-
+    step contractions and caches, and the sampled tokens come out
+    replicated so every host can fetch them.
     """
     policy = policy or make_policy()
     step_model = ProGenDecodeStep(config=config, policy=policy)
 
-    @partial(jax.jit, static_argnames=("length", "top_k", "add_bos", "temperature"))
+    if mesh is not None:
+        from progen_tpu.parallel.sharding import logical_rules
+
+        rules = logical_rules(strategies)
+        repl = NamedSharding(mesh, PartitionSpec())
+        # params shardings are applied via an explicit device_put in the
+        # wrapper below (a no-op when the caller's params already live
+        # there) — jit's in_shardings would reject the static kwargs
+        jit_kwargs = {"out_shardings": repl}
+
+        def trace_ctx():
+            # rules + mesh must be active while flax TRACES the decode
+            # step (same pattern as train/step.py's apply_model)
+            stack = contextlib.ExitStack()
+            stack.enter_context(mesh)
+            stack.enter_context(nn.logical_axis_rules(rules))
+            return stack
+    else:
+        jit_kwargs = {}
+        trace_ctx = contextlib.ExitStack
+
+    @partial(jax.jit, static_argnames=("length", "top_k", "add_bos", "temperature"),
+             **jit_kwargs)
     def sample(params, key, prime, length, top_k=None, add_bos=False,
                temperature=1.0):
         if prime.ndim != 2:
@@ -81,30 +142,46 @@ def make_sampler(config: ProGenConfig, policy: Policy | None = None):
 
         seq = jnp.zeros((b, length), jnp.int32)
         seq = jax.lax.dynamic_update_slice(seq, prime.astype(jnp.int32), (0, 0))
-        caches = init_caches(config, b, policy, decode_len=length)
 
-        def body(carry, pos):
-            seq, caches, key = carry
-            tok = jax.lax.dynamic_index_in_dim(seq, pos, axis=1, keepdims=False)
-            logits, caches = step_model.apply(params, tok, pos, caches)
-            key, sub = jax.random.split(key)
-            nxt = gumbel_topk_sample(sub, logits.astype(jnp.float32), top_k,
-                                     temperature).astype(jnp.int32)
-            write = (pos + 1 >= start_pos) & (pos + 1 < length)
-            cur = jax.lax.dynamic_index_in_dim(seq, jnp.minimum(pos + 1, length - 1),
-                                               axis=1, keepdims=False)
-            val = jnp.where(write, nxt, cur)
-            seq = jax.lax.dynamic_update_index_in_dim(
-                seq, val, jnp.minimum(pos + 1, length - 1), axis=1
+        with trace_ctx():
+            caches = init_caches(config, b, policy, decode_len=length)
+            if mesh is not None:
+                caches = _constrain_caches(caches, mesh, strategies)
+
+            def body(carry, pos):
+                seq, caches, key = carry
+                tok = jax.lax.dynamic_index_in_dim(seq, pos, axis=1,
+                                                   keepdims=False)
+                logits, caches = step_model.apply(params, tok, pos, caches)
+                key, sub = jax.random.split(key)
+                nxt = gumbel_topk_sample(sub, logits.astype(jnp.float32), top_k,
+                                         temperature).astype(jnp.int32)
+                write = (pos + 1 >= start_pos) & (pos + 1 < length)
+                cur = jax.lax.dynamic_index_in_dim(
+                    seq, jnp.minimum(pos + 1, length - 1), axis=1,
+                    keepdims=False)
+                val = jnp.where(write, nxt, cur)
+                seq = jax.lax.dynamic_update_index_in_dim(
+                    seq, val, jnp.minimum(pos + 1, length - 1), axis=1
+                )
+                return (seq, caches, key), None
+
+            (seq, _, _), _ = jax.lax.scan(
+                body, (seq, caches, key), jnp.arange(length)
             )
-            return (seq, caches, key), None
-
-        (seq, _, _), _ = jax.lax.scan(
-            body, (seq, caches, key), jnp.arange(length)
-        )
         return truncate_after_eos(seq)
 
-    return sample
+    if params_shardings is None:
+        return sample
+
+    def sharded_sample(params, key, prime, length, top_k=None, add_bos=False,
+                       temperature=1.0):
+        params = jax.device_put(params, {"params": params_shardings})
+        return sample(params, key, prime, length, top_k=top_k,
+                      add_bos=add_bos, temperature=temperature)
+
+    sharded_sample.lower = sample.lower  # AOT warm-compile hook
+    return sharded_sample
 
 
 def teacher_forced_logits(config: ProGenConfig, params, tokens,

@@ -26,8 +26,14 @@ if os.environ.get("JAX_PLATFORMS"):
                    "the model's trained seq_len, capped there (the learned "
                    "gMLP weights have no rows past it). Short decodes are "
                    "cheap: caches and the scan are sized to this length.")
+@click.option("--mesh", "mesh_spec", default=None,
+              help="mesh axis sizes data,fsdp,tensor,seq (-1 = remaining); "
+                   "restores the params SHARDED over the mesh and decodes "
+                   "SPMD — required when the model does not fit one chip")
+@click.option("--strategies", default="fsdp",
+              help="comma list of sharding strategies for --mesh restores")
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
-         seq_len):
+         seq_len, mesh_spec, strategies):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -52,7 +58,23 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
     policy = make_policy(True)
     model = ProGen(config=model_config, policy=policy)
     sample_tokens = jnp.zeros((1, model_config.seq_len), jnp.int32)
-    params = store.restore_params(abstract_params_like(model, sample_tokens))
+
+    mesh = None
+    strategy_list = tuple(strategies.split(","))
+    param_sh = None
+    if mesh_spec is not None:
+        from progen_tpu.core.mesh import MeshConfig, make_mesh
+        from progen_tpu.parallel.sharding import param_shardings
+
+        try:
+            mesh = make_mesh(MeshConfig.parse(mesh_spec))
+        except ValueError as e:
+            raise click.BadParameter(str(e), param_hint="--mesh")
+        # restore each shard straight to its device — no host ever holds
+        # the full state (the whole point for >1-chip models)
+        param_sh = param_shardings(model, sample_tokens, mesh, strategy_list)
+    params = store.restore_params(
+        abstract_params_like(model, sample_tokens, shardings=param_sh))
     store.close()
 
     num_params = sum(x.size for x in jax.tree.leaves(params))
@@ -72,7 +94,8 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                      if prime_tokens else jnp.zeros((1, 0), jnp.int32),
                      (num_samples, 1))
 
-    sampler = make_sampler(model_config, policy)
+    sampler = make_sampler(model_config, policy, mesh=mesh,
+                           strategies=strategy_list, params_shardings=param_sh)
     keys = KeySeq(seed)
     # add_bos handles empty primes too (a lone BOS column primes the model)
     if batch.shape[1] == 0:
