@@ -25,12 +25,18 @@ loss+gradients WITHOUT optimizer state, the only way to run the 1.2B+
 configs on a single 16GB v5e chip (f32 Adam moments alone exceed HBM;
 the north-star v4-32 setting shards them over fsdp).  The metric string
 labels the mode so the numbers cannot be confused.
+
+PROGEN_BENCH_CONFIGS=small,base,large runs the whole ladder — one JSON
+line per config, each with the per-config defaults from LADDER (the
+best-known single-chip setting for that scale, benchmarks/configs.md) —
+so a single driver invocation captures every scale, not just small.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -52,7 +58,19 @@ def synthetic_uniref_batch(rng: np.random.Generator, batch: int, seq_len: int):
     return out
 
 
-def main() -> None:
+# Per-config ladder defaults: the best-known single-chip setting for each
+# scale (measured, benchmarks/configs.md).  large trains its full step
+# only sharded (f32 Adam state > one chip's HBM), so its single-chip row
+# is fwd+bwd -- the metric string says so.
+LADDER = {
+    "small": dict(batch=8, mode="train", remat=False, remat_policy="full"),
+    "base": dict(batch=4, mode="train", remat=True, remat_policy="attn"),
+    "large": dict(batch=4, mode="fwdbwd", remat=True, remat_policy="full"),
+}
+
+
+def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
+            mode: str, remat: bool, remat_policy: str) -> dict:
     from progen_tpu.core.mesh import MeshConfig, make_mesh
     from progen_tpu.core.precision import make_policy
     from progen_tpu.models import ProGen
@@ -60,15 +78,6 @@ def main() -> None:
     from progen_tpu.observe import PEAK_BF16_TFLOPS, model_flops_per_token
     from progen_tpu.train import make_optimizer, make_train_functions
 
-    config_name = os.environ.get("PROGEN_BENCH_CONFIG", "small")
-    batch = int(os.environ.get("PROGEN_BENCH_BATCH", "8"))
-    steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
-    attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "pallas")
-    mode = os.environ.get("PROGEN_BENCH_MODE", "train")
-    remat_default = config_name in ("base", "large", "xl")
-    remat = os.environ.get("PROGEN_BENCH_REMAT",
-                           "1" if remat_default else "0") == "1"
-    remat_policy = os.environ.get("PROGEN_BENCH_REMAT_POLICY", "full")
     warmup = 3
 
     cfg = CONFIGS[config_name]
@@ -153,31 +162,66 @@ def main() -> None:
     )) * 1e12
     mfu = model_flops_per_token(cfg, num_params) * tps_chip / peak
 
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"uniref50-shaped "
-                    f"{'train' if mode == 'train' else 'fwd+bwd (no optimizer)'}"
-                    f" throughput, ProGen-{config_name} "
-                    f"(seq_len {cfg.seq_len}, batch {batch}, bf16, "
-                    f"{attn_impl} attn"
-                    f"{(', remat:' + remat_policy) if remat else ''}, "
-                    f"{n_chips} chip(s))"
-                ),
-                "value": round(tps_chip, 1),
-                "unit": "tokens/sec/chip",
-                # vs_baseline compares TRAIN steps to the train-step north
-                # star; a lighter fwd+bwd-only run must not claim the ratio
-                "vs_baseline": (
-                    round(tps_chip / NORTH_STAR_TOKENS_PER_SEC_PER_CHIP, 3)
-                    if mode == "train" else None
-                ),
-                "mfu": round(mfu, 4),
-                "params": num_params,
-            }
-        )
-    )
+    return {
+        "metric": (
+            f"uniref50-shaped "
+            f"{'train' if mode == 'train' else 'fwd+bwd (no optimizer)'}"
+            f" throughput, ProGen-{config_name} "
+            f"(seq_len {cfg.seq_len}, batch {batch}, bf16, "
+            f"{attn_impl} attn"
+            f"{(', remat:' + remat_policy) if remat else ''}, "
+            f"{n_chips} chip(s))"
+        ),
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        # vs_baseline compares TRAIN steps to the train-step north
+        # star; a lighter fwd+bwd-only run must not claim the ratio
+        "vs_baseline": (
+            round(tps_chip / NORTH_STAR_TOKENS_PER_SEC_PER_CHIP, 3)
+            if mode == "train" else None
+        ),
+        "mfu": round(mfu, 4),
+        "params": num_params,
+    }
+
+
+def main() -> None:
+    steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
+    attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "pallas")
+
+    ladder = os.environ.get("PROGEN_BENCH_CONFIGS")
+    if ladder:
+        for name in (n.strip() for n in ladder.split(",")):
+            if name not in LADDER:
+                print(f"skipping unknown ladder config {name!r} "
+                      f"(known: {', '.join(sorted(LADDER))})",
+                      file=sys.stderr, flush=True)
+                continue
+            spec = dict(LADDER[name])
+            if spec["mode"] == "fwdbwd" and jax.device_count() > 1:
+                # fwdbwd is the single-chip stand-in for configs whose
+                # full train state exceeds one chip; on a real slice the
+                # sharded train mode is the meaningful measurement
+                spec.update(mode="train")
+            print(json.dumps(run_one(
+                name, batch=spec["batch"], steps=steps,
+                attn_impl=attn_impl, mode=spec["mode"], remat=spec["remat"],
+                remat_policy=spec["remat_policy"],
+            )), flush=True)
+        return
+
+    config_name = os.environ.get("PROGEN_BENCH_CONFIG", "small")
+    remat_default = config_name in ("base", "large", "xl")
+    print(json.dumps(run_one(
+        config_name,
+        batch=int(os.environ.get("PROGEN_BENCH_BATCH", "8")),
+        steps=steps,
+        attn_impl=attn_impl,
+        mode=os.environ.get("PROGEN_BENCH_MODE", "train"),
+        remat=os.environ.get("PROGEN_BENCH_REMAT",
+                             "1" if remat_default else "0") == "1",
+        remat_policy=os.environ.get("PROGEN_BENCH_REMAT_POLICY", "full"),
+    )))
 
 
 if __name__ == "__main__":

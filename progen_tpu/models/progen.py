@@ -34,6 +34,7 @@ from typing import Any, Mapping
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh
 
 from progen_tpu.core.precision import Policy, make_policy
@@ -147,6 +148,12 @@ class LocalAttention(nn.Module):
         )
         # rotary on q, k AND v — reference progen.py:87
         q, k, v = (apply_rotary_pos_emb(t, sin, cos) for t in (q, k, v))
+        # names for the 'attn' remat policy (save_only_these_names): the
+        # post-rotary q/k/v feed the attention backward directly, so
+        # saving them skips the norm->qkv->rotary replay
+        q = checkpoint_name(q, "attn_q")
+        k = checkpoint_name(k, "attn_k")
+        v = checkpoint_name(v, "attn_v")
         q = nn.with_logical_constraint(q, ("act_batch", "act_heads", "act_seq", None))
         k = nn.with_logical_constraint(k, ("act_batch", "act_heads", "act_seq", None))
         v = nn.with_logical_constraint(v, ("act_batch", "act_heads", "act_seq", None))
@@ -181,6 +188,7 @@ class LocalAttention(nn.Module):
                 f"unknown attn_impl {self.attn_impl!r}; use 'xla' or 'pallas'"
             )
         out = out.transpose(0, 2, 1, 3).reshape(b, n, inner)
+        out = checkpoint_name(out, "attn_out")
         return _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
                       policy=self.policy, name="to_out")(out)
 
@@ -300,7 +308,13 @@ class ProGen(nn.Module):
     * ``"dots"`` — ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``:
       matmul outputs are saved, only the cheap elementwise/norm/softmax work
       is recomputed — most of full-remat's memory win at a fraction of its
-      recompute FLOPs (the right setting when HBM is tight but not critical).
+      recompute FLOPs (the right setting when HBM is tight but not critical);
+    * ``"attn"`` — save only the attention path (post-rotary q/k/v and the
+      attention output, via ``checkpoint_name``/``save_only_these_names``):
+      the backward replays the feed-forward matmuls but never the
+      norm->qkv->rotary->windowed-attention chain.  Sits between ``full``
+      (save 2 tensors/layer) and ``dots`` (save the fat ff hidden too):
+      ~4x ``full``'s saved bytes, ~none of the attention recompute.
     """
 
     config: ProGenConfig
@@ -355,10 +369,14 @@ class ProGen(nn.Module):
                 ckpt_policy = (
                     jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                 )
+            elif self.remat_policy == "attn":
+                ckpt_policy = jax.checkpoint_policies.save_only_these_names(
+                    "attn_q", "attn_k", "attn_v", "attn_out"
+                )
             else:
                 raise ValueError(
                     f"unknown remat_policy {self.remat_policy!r}; "
-                    "use 'full' or 'dots'"
+                    "use 'full', 'dots' or 'attn'"
                 )
             attn_cls = nn.remat(LocalAttention, policy=ckpt_policy)
             ff_cls = nn.remat(FeedForward, policy=ckpt_policy)

@@ -1,0 +1,304 @@
+"""Per-chip HBM planner for the training step.
+
+The reference never had to think about memory (single GPU, toy config);
+at this framework's target scales the first question is "does this
+(config, mesh, strategies, remat, batch) fit the chip?", and the answer
+used to be "compile it and see" (``benchmarks/configs.md`` records the
+measured OOM boundaries).  This module predicts the answer analytically.
+
+The peak model (calibrated against XLA's ``compiled.memory_analysis()``
+on a v5e across six configurations, all within ~2% — see
+``tools/memory_check.py`` and ``benchmarks/memory_plan.md``):
+
+* **resident state** — f32 params + Adam moments (= the jit ARGUMENTS,
+  12 bytes/param, +4 with a MultiSteps grad accumulator), divided by the
+  axes that shard them (fsdp, tensor).  Gradients do NOT plateau: with
+  donated buffers XLA streams each grad into its param/moment update, so
+  4 bytes/param of grads never shows up in the measured peak;
+* **activation plateau** — an explicit enumeration of the tensors kept
+  live between forward and backward for THIS model's blocks (windowed
+  attention + GEGLU / SGU feed-forward) per remat policy, times a
+  measured scheduling efficiency (XLA's own rematerializer trims the
+  naive set: x0.82 no-remat, x0.91 dots, x1.0 full);
+* the peak temp is ``max(activation plateau, bf16 param-cast set)`` —
+  when remat shrinks activations below the bf16 weight copies (2
+  bytes/param), the casts become the floor (measured at large/batch-1) —
+  plus the f32 logits+softmax pair.
+
+``Trainer`` calls :func:`check_fits` to fail fast with the predicted
+breakdown and actionable knobs instead of a 20-minute compile ending in
+RESOURCE_EXHAUSTED.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+GiB = 1024**3
+
+
+# XLA scheduling efficiency on the naive saved-tensor enumeration,
+# fitted to v5e memory_analysis measurements (benchmarks/memory_plan.md)
+ACT_EFFICIENCY = {"none": 0.82, "dots": 0.91, "full": 1.0, "attn": 1.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryPlan:
+    """Predicted per-chip HBM for one training-step configuration."""
+
+    params_bytes: int
+    moments_bytes: int
+    accumulator_bytes: int
+    activation_bytes: int
+    cast_bytes: int
+    logits_bytes: int
+    num_params: int
+    detail: dict
+    snapshot_bytes: int = 0
+
+    @property
+    def state_bytes(self) -> int:
+        return self.params_bytes + self.moments_bytes + self.accumulator_bytes
+
+    @property
+    def temp_bytes(self) -> int:
+        return max(self.activation_bytes, self.cast_bytes) + self.logits_bytes
+
+    @property
+    def total_bytes(self) -> int:
+        return self.state_bytes + self.temp_bytes + self.snapshot_bytes
+
+    def report(self) -> str:
+        rows = [
+            ("params (f32)", self.params_bytes),
+            ("adam moments (f32)", self.moments_bytes),
+            ("grad accumulator (f32)", self.accumulator_bytes),
+            ("activation plateau", self.activation_bytes),
+            ("bf16 param casts", self.cast_bytes),
+            ("f32 logits + softmax bwd", self.logits_bytes),
+            ("background-checkpoint snapshot", self.snapshot_bytes),
+            ("peak = state + max(act, cast) + logits + snapshot",
+             self.total_bytes),
+        ]
+        return "\n".join(f"  {name:<48} {b / GiB:7.2f} GiB" for name, b in rows)
+
+
+def count_params(cfg) -> int:
+    """Exact parameter count of the flax model (closed form; matches
+    ``jax.eval_shape`` — asserted in tests)."""
+    d, inner = cfg.dim, cfg.heads * cfg.dim_head
+    n = cfg.num_tokens * d  # embed
+    for i in range(cfg.depth):
+        gmlp = cfg.layer_uses_gmlp(i)
+        # attention: norm scale, qkv (no bias), out (+bias)
+        n += d + d * 3 * inner + inner * d + d
+        hidden = d * cfg.ff_mult * (1 if gmlp or not cfg.ff_glu else 2)
+        # ff: norm scale, proj_in (+bias)
+        n += d + d * hidden + hidden
+        if gmlp:
+            half = (d * cfg.ff_mult) // 2
+            # sgu: norm scale, spatial weights/biases, proj_out (+bias)
+            n += half + cfg.seq_len * cfg.seq_len + cfg.seq_len
+            n += half * half + half
+            n += half * d + d  # ff proj_out from half
+        else:
+            n += (hidden // (2 if cfg.ff_glu else 1)) * d + d  # ff proj_out
+    n += d + d * cfg.num_tokens + cfg.num_tokens  # head norm + linear
+    return n
+
+
+def _layer_saved_bytes(cfg, tokens: int, policy: str, attn_impl: str,
+                       gmlp: bool, act: int, tensor: int = 1) -> int:
+    """Bytes of forward tensors kept for the backward of ONE layer
+    (attention block + feed-forward block), per remat policy.
+
+    ``act`` is the activation element size (2 for bf16 compute).
+    ``tensor``: megatron tp degree — the qkv/hidden/heads activations are
+    column-sharded over it; the residual-stream (dim-wide) tensors
+    replicate.
+    """
+    d = cfg.dim
+    inner = cfg.heads * cfg.dim_head // tensor
+    t = tokens
+    hidden = d * cfg.ff_mult * (1 if gmlp or not cfg.ff_glu else 2) // tensor
+    half = (d * cfg.ff_mult) // 2 // tensor
+
+    # residual-stream block inputs are always live (checkpoint args)
+    saved = 2 * t * d * act
+
+    if policy == "full":
+        # jax.checkpoint(block): nothing else saved; backward recomputes
+        return saved
+
+    if policy == "attn":
+        # save_only_these_names: post-rotary q/k/v + attention output
+        return saved + 4 * t * inner * act
+
+    # matmul ("dot") outputs, saved by the dots policy and by no-remat
+    saved += t * 3 * inner * act          # qkv projection
+    saved += t * d * act                  # attention out projection
+    saved += t * hidden * act             # ff proj_in
+    saved += t * d * act                  # ff proj_out
+    if gmlp:
+        saved += t * half * act           # sgu spatial matmul output
+        saved += t * half * act           # sgu proj_out
+    if policy == "dots":
+        return saved
+
+    # no remat: every intermediate XLA keeps live
+    saved += 2 * t * d * act              # the two LayerNorm outputs
+    saved += 3 * t * inner * act          # post-rotary q, k, v
+    if attn_impl == "pallas":
+        # flash-style backward recomputes probs from q/k/v; keeps out+lse
+        saved += t * inner * act + t * (cfg.heads // tensor) * 4
+    else:
+        saved += t * (cfg.heads // tensor) * 2 * cfg.window_size * act  # probs
+        saved += t * inner * act          # attention output
+    if gmlp:
+        saved += t * half * act           # gelu output (gate half)
+        saved += t * half * act           # normed gate
+        saved += t * half * act           # x * gate
+    else:
+        saved += t * (hidden // (2 if cfg.ff_glu else 1)) * act  # (ge)glu out
+    return saved
+
+
+def plan(
+    cfg,
+    *,
+    batch_size: int,
+    mesh_shape: dict | None = None,
+    strategies: Sequence[str] = ("dp",),
+    remat: bool = False,
+    remat_policy: str = "full",
+    attn_impl: str = "pallas",
+    mixed_precision: bool = True,
+    grad_accum_every: int = 1,
+    checkpoint_snapshot: bool = False,
+) -> MemoryPlan:
+    """Predict per-chip HBM for one jitted train step.
+
+    ``batch_size`` is the GLOBAL micro-batch fed to ``train_step``;
+    ``mesh_shape`` like ``{"data": 1, "fsdp": 8, "tensor": 1, "seq": 1}``
+    (None = single chip).
+    """
+    mesh_shape = mesh_shape or {}
+    data = mesh_shape.get("data", 1)
+    fsdp = mesh_shape.get("fsdp", 1)
+    tensor = mesh_shape.get("tensor", 1) if "tp" in strategies else 1
+    seq = mesh_shape.get("seq", 1) if "sp" in strategies else 1
+
+    n = count_params(cfg)
+    # fsdp shards every matrix param; tp shards qkv/mlp matrices.  Model
+    # both as dividing the full count (norm scales that replicate are
+    # O(depth*dim), noise at these scales).
+    state_shard = (fsdp if "fsdp" in strategies else 1) * tensor
+    params_b = 4 * n // state_shard
+    moments_b = 8 * n // state_shard
+    accum_b = (4 * n // state_shard) if grad_accum_every > 1 else 0
+
+    act = 2 if mixed_precision else 4
+    # per-chip tokens: batch sharded over (data, fsdp), sequence over seq
+    tokens = batch_size * cfg.seq_len // (data * max(fsdp, 1) * seq)
+
+    policy = remat_policy if remat else "none"
+    act_b = 0
+    peak_layer = 0
+    for i in range(cfg.depth):
+        gmlp = cfg.layer_uses_gmlp(i)
+        act_b += _layer_saved_bytes(cfg, tokens, policy, attn_impl, gmlp, act,
+                                    tensor)
+        peak_layer = max(
+            peak_layer,
+            _layer_saved_bytes(cfg, tokens, "none", attn_impl, gmlp, act,
+                               tensor),
+        )
+    if policy in ("full", "attn"):
+        # the backward replays one block at a time: its full live set
+        # rides on top of the saved block inputs
+        act_b += peak_layer
+    act_b = int(act_b * ACT_EFFICIENCY[policy])
+
+    cast_b = (2 * n // state_shard) if mixed_precision else 0
+    # f32 logits + softmax backward copy
+    logits_b = 2 * tokens * cfg.num_tokens * 4
+
+    detail = {
+        "tokens_per_chip": tokens,
+        "state_shard_ways": state_shard,
+        "remat": policy,
+        "attn_impl": attn_impl,
+    }
+    # Trainer's background checkpointing keeps one extra on-device copy of
+    # the full state while the save's device->host fetch runs
+    snapshot_b = (params_b + moments_b + accum_b) if checkpoint_snapshot else 0
+
+    return MemoryPlan(
+        params_bytes=params_b,
+        moments_bytes=moments_b,
+        accumulator_bytes=accum_b,
+        activation_bytes=act_b,
+        cast_bytes=cast_b,
+        logits_bytes=logits_b,
+        num_params=n,
+        detail=detail,
+        snapshot_bytes=snapshot_b,
+    )
+
+
+def device_hbm_bytes(device=None) -> int | None:
+    """Usable HBM of the local accelerator, or None when unknown."""
+    import jax
+
+    device = device or jax.devices()[0]
+    if device.platform != "tpu":
+        return None
+    try:
+        stats = device.memory_stats()
+        return int(stats["bytes_limit"])
+    except Exception:
+        return None
+
+
+def check_fits(plan_: MemoryPlan, hbm_bytes: int | None,
+               headroom: float = 0.02) -> str | None:
+    """None when the plan fits; otherwise a multi-line error message with
+    the breakdown and the knobs most likely to make it fit."""
+    if hbm_bytes is None:
+        return None
+    budget = hbm_bytes * (1 - headroom)
+    if plan_.total_bytes <= budget:
+        return None
+    suggestions = []
+    if (plan_.snapshot_bytes
+            and plan_.total_bytes - plan_.snapshot_bytes <= budget):
+        suggestions.append(
+            "disable background checkpointing (--no_background_checkpoint): "
+            "its on-device state snapshot is what does not fit"
+        )
+    if plan_.activation_bytes > plan_.cast_bytes:
+        # escalation order measured in benchmarks/configs.md: 'attn' keeps
+        # the most throughput per byte saved; 'full' saves the most bytes
+        if plan_.detail["remat"] == "none":
+            suggestions.append("enable remat (--remat; policy 'attn' first)")
+        elif plan_.detail["remat"] == "dots":
+            suggestions.append(
+                "try --remat_policy attn (slimmer saved set) or full")
+        elif plan_.detail["remat"] == "attn":
+            suggestions.append("use --remat_policy full (recompute more)")
+        suggestions.append("reduce --batch_size (activations scale with it)")
+    if plan_.state_bytes > 0.7 * budget:
+        # the f32 state is the blocker: it must shrink to leave room for
+        # the step's working set -> shard it harder
+        total_state = plan_.state_bytes * plan_.detail["state_shard_ways"]
+        ways = max(2, -(-total_state // int(budget * 0.6)))
+        suggestions.append(
+            f"the f32 optimizer state dominates HBM: shard it (fsdp={ways} "
+            "in --mesh, with 'fsdp' in --strategies)"
+        )
+    return (
+        f"predicted per-chip HBM {plan_.total_bytes / GiB:.2f} GiB exceeds "
+        f"the chip's {hbm_bytes / GiB:.2f} GiB:\n{plan_.report()}\n"
+        "try: " + "; ".join(suggestions or ["a bigger mesh"])
+    )

@@ -1,0 +1,119 @@
+"""Validate the memory planner against XLA's own accounting.
+
+For each (config, batch, remat, policy) point this AOT-compiles the real
+jitted train step on the attached TPU — compile only, nothing executes —
+and reads ``compiled.memory_analysis()`` (XLA's buffer-assignment peak,
+the same number the RESOURCE_EXHAUSTED error reports).  Points that do
+not fit print the OOM message's "Used N of M hbm" figure instead.
+
+Output: one JSON line per point with predicted vs measured bytes, plus a
+markdown table for ``benchmarks/memory_plan.md``.
+
+Usage: ``python tools/memory_check.py [point ...]`` where a point is
+``config:batch:remat`` e.g. ``base:4:dots`` ``small:8:none``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_POINTS = [
+    "small:8:none", "small:16:none",
+    "base:2:dots", "base:4:dots", "base:8:full",
+    "large:1:full",
+]
+
+
+def measure(config_name: str, batch: int, remat: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.models import ProGen
+    from progen_tpu.models.configs import CONFIGS
+    from progen_tpu.train import make_optimizer, make_train_functions
+    from progen_tpu.train.memory import GiB, device_hbm_bytes, plan
+
+    cfg = CONFIGS[config_name]
+    p = plan(cfg, batch_size=batch, remat=remat != "none",
+             remat_policy=remat if remat != "none" else "full",
+             attn_impl="pallas", mixed_precision=True)
+    out = {
+        "point": f"{config_name}:b{batch}:{remat}",
+        "predicted_bytes": int(p.total_bytes),
+        "predicted_gib": round(p.total_bytes / GiB, 2),
+        "state_gib": round(p.state_bytes / GiB, 2),
+        "act_gib": round(p.activation_bytes / GiB, 2),
+        "cast_gib": round(p.cast_bytes / GiB, 2),
+        "hbm_gib": round((device_hbm_bytes() or 0) / GiB, 2),
+    }
+
+    model = ProGen(config=cfg, policy=make_policy(True), attn_impl="pallas",
+                   remat=remat != "none",
+                   remat_policy=remat if remat != "none" else "full")
+    sample = jnp.zeros((batch, cfg.seq_len), jnp.int32)
+    fns = make_train_functions(model, make_optimizer(2e-4), sample)
+
+    def abstract_state():
+        return jax.eval_shape(fns.init_state, jax.random.key(0))
+
+    st = abstract_state()
+    b = jax.ShapeDtypeStruct((batch, cfg.seq_len + 1), jnp.int32)
+    try:
+        compiled = fns.train_step.lower(st, b).compile()
+        mem = compiled.memory_analysis()
+        # peak = everything resident: args (state) + temps + output aliases
+        measured = int(mem.argument_size_in_bytes + mem.temp_size_in_bytes
+                       + mem.output_size_in_bytes
+                       - mem.alias_size_in_bytes)
+        out.update(
+            measured_bytes=measured,
+            measured_gib=round(measured / GiB, 2),
+            argument_gib=round(mem.argument_size_in_bytes / GiB, 2),
+            temp_gib=round(mem.temp_size_in_bytes / GiB, 2),
+            output_gib=round(mem.output_size_in_bytes / GiB, 2),
+            alias_gib=round(mem.alias_size_in_bytes / GiB, 2),
+            fits=True,
+        )
+    except Exception as e:  # RESOURCE_EXHAUSTED carries the real peak
+        msg = str(e)
+        m = re.search(r"Used ([\d.]+)([GM]) of", msg)
+        if not m:
+            out.update(error=msg[:500], fits=False)
+        else:
+            scale = GiB if m.group(2) == "G" else 1024**2
+            out.update(
+                measured_bytes=int(float(m.group(1)) * scale),
+                measured_gib=round(float(m.group(1)) * scale / GiB, 2),
+                fits=False,
+            )
+    if "measured_bytes" in out:
+        out["pred_over_measured"] = round(
+            out["predicted_bytes"] / out["measured_bytes"], 3)
+    return out
+
+
+def main() -> None:
+    points = sys.argv[1:] or DEFAULT_POINTS
+    path = os.path.join(REPO, "benchmarks", "memory_measurements.json")
+    results: dict[str, dict] = {}
+    if os.path.exists(path):
+        results = {r["point"]: r for r in json.load(open(path))}
+    for pt in points:
+        name, batch, remat = pt.split(":")
+        r = measure(name, int(batch), remat)
+        results[r["point"]] = r
+        print(json.dumps(r), flush=True)
+    with open(path, "w") as fh:
+        json.dump(list(results.values()), fh, indent=1)
+    print(f"wrote {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -60,7 +60,10 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--data_path", default="./train_data")
 @click.option("--shuffle_buffer", default=0,
               help="sliding-window record shuffle (0 = off, reference "
-                   "behavior; data is already shuffled at prep)")
+                   "behavior; data is already shuffled at prep). Resume "
+                   "caveat: the shuffle is applied AFTER the resume skip, "
+                   "so records within ~buffer distance of the resume cursor "
+                   "can repeat or be deferred to the next epoch pass")
 @click.option("--wandb_off", default=False, is_flag=True)
 @click.option("--wandb_project_name", default="progen-training")
 @click.option("--new", default=False, is_flag=True)
@@ -72,11 +75,21 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--remat", default=False, is_flag=True,
               help="rematerialize blocks in backward (saves HBM)")
 @click.option("--remat_policy", default="full",
-              type=click.Choice(["full", "dots"]),
+              type=click.Choice(["full", "dots", "attn"]),
               help="full: recompute everything; dots: save matmul outputs, "
-                   "recompute only elementwise work")
+                   "recompute only elementwise work; attn: save the "
+                   "attention path (q/k/v + out), replay only the "
+                   "feed-forward")
 @click.option("--attn_impl", default="xla", type=click.Choice(["xla", "pallas"]),
               help="windowed attention implementation")
+@click.option("--prefetch_depth", default=2,
+              help="device batches buffered ahead of the step consuming "
+                   "them (0 = synchronous reference-style feed)")
+@click.option("--background_checkpoint/--no_background_checkpoint",
+              default=True,
+              help="checkpoint via an on-device state snapshot + background "
+                   "device->host fetch (costs one state-sized HBM copy; "
+                   "disable when HBM is tight)")
 @click.option("--log_every", default=10)
 @click.option("--max_steps", default=None, type=int)
 @click.option("--profile_dir", default=None, type=str)
@@ -121,9 +134,10 @@ def main(**flags):
     store.close()
     model_config = ProGenConfig.from_dict(model_kwargs)
 
-    axes = [int(x) for x in flags["mesh_spec"].split(",")]
-    mesh_cfg = MeshConfig(data=axes[0], fsdp=axes[1], tensor=axes[2],
-                          seq=axes[3])
+    try:
+        mesh_cfg = MeshConfig.parse(flags["mesh_spec"])
+    except ValueError as e:
+        raise click.BadParameter(str(e), param_hint="--mesh")
 
     cfg = TrainerConfig(
         seed=flags["seed"],
@@ -149,6 +163,8 @@ def main(**flags):
         remat=flags["remat"],
         remat_policy=flags["remat_policy"],
         attn_impl=flags["attn_impl"],
+        prefetch_depth=flags["prefetch_depth"],
+        background_checkpoint=flags["background_checkpoint"],
         log_every=flags["log_every"],
         max_steps=flags["max_steps"],
         profile_dir=flags["profile_dir"],
