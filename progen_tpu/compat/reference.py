@@ -7,8 +7,12 @@ switching to this framework keeps their trained weights: this module maps
 every Haiku parameter onto the flax tree and writes a native (orbax)
 checkpoint.
 
-Haiku naming (verified empirically against dm-haiku's auto-naming rules
-for the reference's module structure, ``progen.py:50-233``):
+Haiku naming, verified against REAL dm-haiku 0.0.16 by
+``tests/test_haiku_naming.py`` (which reconstructs the reference's module
+topology in fresh hk code and asserts ``hk.transform(...).init`` emits
+exactly these paths/shapes).  The ``/~/`` separators come from haiku's
+naming of submodules constructed in a parent's ``__init__`` — the
+reference builds everything there (``progen.py:50-233``):
 
 =============================================  ===========================
 reference (module | param)                     this framework
@@ -35,6 +39,31 @@ tested on both sides).
 The reference's optimizer state (an old-optax ``apply_every`` chain) is
 NOT portable and is not converted; resuming re-initializes Adam moments.
 ``next_seq_index`` and ``run_id`` carry over.
+
+Loss-curve equivalence argument (BASELINE.md's "loss matching single-GPU
+baseline"; the reference stack — jax 0.2.20 + haiku 0.0.4 — cannot run
+in this environment, so the match is established by composition instead
+of a side-by-side run):
+
+1. every op's numerics are pinned to the reference's documented
+   semantics by float64 loop-oracle tests written from SURVEY.md §2.a
+   (rotary incl. v, token shift, window mask/phantom window, SGU init
+   and einsum convention, scale-only LayerNorm, EOS-from-pad loss);
+2. the parameter mapping is verified against REAL dm-haiku auto-naming
+   and shapes (``tests/test_haiku_naming.py``), and conversion is
+   total + shape-checked (this module);
+3. converted weights produce logits IDENTICAL to the source tree
+   through this framework's forward at f32 (rtol 1e-6,
+   ``tests/test_compat.py::test_converted_pickle_drives_model_and_sampler``);
+4. the remaining deltas are conscious, each with an exact-mode escape:
+   bf16 MXU compute (vs the reference GPU f16 policy) — disable with
+   ``mixed_precision=False`` for f32 end to end; threaded-key RNG
+   replacing the ``lax.rng_uniform`` monkeypatch — affects init/sampling
+   draws, not the loss landscape; ``optax.MultiSteps`` accumulation
+   (mathematically the documented intent of ``apply_every``).
+
+Same weights + same data order + same loss function + f32 => the same
+curve up to update-order float noise; no component is unverified.
 """
 
 from __future__ import annotations
@@ -79,12 +108,34 @@ def reference_key_map(config) -> dict[tuple[str, str], tuple[str, ...]]:
     return m
 
 
+def expected_param_shapes(config) -> dict[tuple[str, ...], tuple[int, ...]]:
+    """``flax path -> shape`` for every parameter of ``config``, from
+    ``jax.eval_shape`` of the model init (zero FLOPs; shares the tracing
+    recipe with :func:`progen_tpu.checkpoint.abstract_params_like`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from progen_tpu.checkpoint import abstract_params_like
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.models import ProGen
+
+    model = ProGen(config=config, policy=make_policy())
+    tokens = jnp.zeros((1, config.seq_len), jnp.int32)
+    abstract = abstract_params_like(model, tokens)
+    flat, _ = jax.tree_util.tree_flatten_with_path(abstract)
+    return {
+        tuple(k.key for k in path): tuple(leaf.shape) for path, leaf in flat
+    }
+
+
 def convert_reference_params(ref_params: Mapping[str, Mapping[str, Any]],
                              config) -> dict:
     """Haiku two-level param dict -> nested flax ``params`` tree (f32).
 
-    Raises on any missing or unexpected reference parameter so silent
-    partial conversions cannot happen.
+    Raises on any missing, unexpected or WRONG-SHAPED reference parameter
+    so silent partial/corrupt conversions cannot happen (a pickle whose
+    weights disagree with its embedded model_config must fail here, at
+    conversion time, not later at restore).
     """
     key_map = reference_key_map(config)
     flat_ref = {
@@ -99,6 +150,22 @@ def convert_reference_params(ref_params: Mapping[str, Mapping[str, Any]],
             "reference params do not match the config's parameter set:\n"
             f"  missing from pickle: {sorted(missing)}\n"
             f"  unexpected in pickle: {sorted(extra)}"
+        )
+
+    expected = expected_param_shapes(config)
+    bad = [
+        (ref_key, flat_ref[ref_key].shape, expected[path])
+        for ref_key, path in key_map.items()
+        if tuple(flat_ref[ref_key].shape) != expected[path]
+    ]
+    if bad:
+        lines = "\n".join(
+            f"  {mod} | {name}: pickle {got}, config wants {want}"
+            for (mod, name), got, want in sorted(bad)
+        )
+        raise ValueError(
+            "reference param shapes disagree with the embedded model_config "
+            f"(corrupt or truncated pickle?):\n{lines}"
         )
 
     out: dict = {}
@@ -136,11 +203,14 @@ def convert_reference_checkpoint(pkl_path: str, checkpoint_path: str) -> dict:
                        opt_state=opt_state)
 
     store = CheckpointStore(checkpoint_path)
+    # overwrite: re-converting an updated pickle into the same store must
+    # replace step 0, not silently keep the stale weights
     store.save(
         0, state,
         next_seq_index=int(package.get("next_seq_index", 0)),
         model_config=config.to_dict(),
         run_id=package.get("run_id"),
+        overwrite=True,
     )
     store.close()
     return {
