@@ -1,0 +1,232 @@
+"""Exercise every ``gs://`` branch against a FAKE bucket.
+
+The reference's GCS support was load-bearing (checkpoint blobs,
+``checkpoint.py:41-81``; tfrecord glob, ``data.py:41-46``; data-prep
+upload, ``generate_data.py:123-131``).  This framework's equivalents
+route through three seams — ``tf.io``/``tf.data`` (tfrecord IO),
+``etils.epath`` (orbax store + fasta staging) — so a fake bucket is a
+path mapper at those seams: ``gs://<bucket>/<rest>`` becomes
+``<tmpdir>/<bucket>/<rest>`` while every line of the production gs://
+branches executes for real (TFRecordWriter GZIP framing, gfile glob,
+epath rmtree/mkdir/write_bytes, orbax manager lifecycle).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class FakeBucket:
+    """gs:// URL <-> local directory mapping."""
+
+    def __init__(self, root: Path):
+        self.root = root
+
+    def to_local(self, url) -> str:
+        url = str(url)
+        if url.startswith("gs://"):
+            local = self.root / url[len("gs://"):]
+            local.parent.mkdir(parents=True, exist_ok=True)
+            return str(local)
+        return url
+
+    def to_url(self, local: str) -> str:
+        return "gs://" + str(Path(local).relative_to(self.root))
+
+
+class _ShimGfile:
+    def __init__(self, real_tf, bucket: FakeBucket):
+        self._gfile = real_tf.io.gfile
+        self._bucket = bucket
+
+    def glob(self, pattern: str):
+        if pattern.startswith("gs://"):
+            import glob as pyglob
+
+            hits = pyglob.glob(self._bucket.to_local(pattern))
+            return [self._bucket.to_url(h) for h in hits]
+        return self._gfile.glob(pattern)
+
+    def __getattr__(self, name):
+        return getattr(self._gfile, name)
+
+
+class _ShimIO:
+    def __init__(self, real_tf, bucket: FakeBucket):
+        self._io = real_tf.io
+        self._bucket = bucket
+        self.gfile = _ShimGfile(real_tf, bucket)
+
+    def TFRecordWriter(self, path, options=None):
+        return self._io.TFRecordWriter(self._bucket.to_local(path), options)
+
+    def __getattr__(self, name):
+        return getattr(self._io, name)
+
+
+class _ShimData:
+    def __init__(self, real_tf, bucket: FakeBucket):
+        self._data = real_tf.data
+        self._bucket = bucket
+
+    def TFRecordDataset(self, filenames, **kwargs):
+        mapped = [self._bucket.to_local(f) for f in filenames]
+        return self._data.TFRecordDataset(mapped, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._data, name)
+
+
+class ShimTF:
+    def __init__(self, real_tf, bucket: FakeBucket):
+        self._tf = real_tf
+        self.io = _ShimIO(real_tf, bucket)
+        self.data = _ShimData(real_tf, bucket)
+
+    def __getattr__(self, name):
+        return getattr(self._tf, name)
+
+
+@pytest.fixture()
+def fake_bucket(tmp_path, monkeypatch):
+    from progen_tpu.data import tfrecord
+
+    bucket = FakeBucket(tmp_path / "gcs")
+    real_tf = tfrecord._tf()
+    shim = ShimTF(real_tf, bucket)
+    monkeypatch.setattr(tfrecord, "_tf", lambda: shim)
+    return bucket
+
+
+def test_tfrecord_write_glob_count_read_via_gs(fake_bucket):
+    """write_tfrecord's GCS branch (tf.io.TFRecordWriter) + list_shards'
+    gfile.glob + the tf.data read path, all through gs:// URLs; the
+    GCS-branch bytes must collate identically to the local pure-Python
+    writer's."""
+    from progen_tpu.data.tfrecord import (
+        iterator_from_tfrecords_folder,
+        list_shards,
+        shard_filename,
+        write_tfrecord,
+    )
+
+    payloads = [b"# MKV", b"# AACD", b"# QQERST"]
+    url_dir = "gs://fake-bucket/uniref"
+    url = f"{url_dir}/{shard_filename(0, len(payloads), 'train')}"
+    n = write_tfrecord(url, payloads)
+    assert n == len(payloads)
+    # the record really went through tf's writer into the fake bucket
+    assert Path(fake_bucket.to_local(url)).exists()
+
+    shards = list_shards(url_dir, "train")
+    assert shards == [url]
+
+    total, get_it = iterator_from_tfrecords_folder(url_dir, "train")
+    assert total == len(payloads)
+    batch = next(get_it(seq_len=10, batch_size=3))
+
+    # parity with the pure-Python local writer on the same payloads
+    local_dir = fake_bucket.root / "local"
+    local_dir.mkdir()
+    write_tfrecord(
+        str(local_dir / shard_filename(0, len(payloads), "train")), payloads)
+    total2, get_it2 = iterator_from_tfrecords_folder(str(local_dir), "train")
+    assert total2 == total
+    np.testing.assert_array_equal(
+        batch, next(get_it2(seq_len=10, batch_size=3)))
+
+
+def test_checkpoint_store_roundtrip_via_gs(fake_bucket, monkeypatch,
+                                           tmp_path):
+    """CheckpointStore handed a gs:// URL: save, latest_step, meta +
+    params-only + full-state restore, keep-N pruning — through the epath
+    seam orbax itself uses."""
+    from etils import epath as real_epath
+
+    from progen_tpu.checkpoint import store as store_mod
+    from progen_tpu.checkpoint import abstract_state_like
+
+    class _ShimEpath:
+        def Path(self, p, *parts):
+            return real_epath.Path(fake_bucket.to_local(p), *parts)
+
+        def __getattr__(self, name):
+            return getattr(real_epath, name)
+
+    monkeypatch.setattr(store_mod, "epath", _ShimEpath())
+
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.models import ProGen, ProGenConfig
+    from progen_tpu.train import make_optimizer, make_train_functions
+
+    cfg = ProGenConfig(num_tokens=32, dim=16, seq_len=16, depth=2,
+                       window_size=8, global_mlp_depth=1, heads=2,
+                       dim_head=8, ff_mult=2)
+    model = ProGen(config=cfg, policy=make_policy(False))
+    fns = make_train_functions(model, make_optimizer(1e-3),
+                               jnp.zeros((2, cfg.seq_len), jnp.int32))
+    state = fns.init_state(jax.random.key(0))
+
+    store = store_mod.CheckpointStore("gs://fake-bucket/ckpts", keep_last_n=1)
+    for step in (1, 2):
+        store.save(step, state, next_seq_index=step * 7,
+                   model_config=cfg.to_dict(), run_id="gcsrun")
+    store.wait_until_finished()
+    assert store.latest_step() == 2
+    meta = store.restore_meta()
+    assert meta["next_seq_index"] == 14 and meta["run_id"] == "gcsrun"
+
+    restored = store.restore_state(abstract_state_like(fns))
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    store.close()
+
+    # the bytes live under the fake bucket, and keep-N pruned step 1
+    bucket_dir = Path(fake_bucket.to_local("gs://fake-bucket/ckpts"))
+    steps = sorted(p.name for p in bucket_dir.iterdir() if p.name.isdigit())
+    assert steps == ["2"]
+
+
+def test_fasta_prep_uploads_to_gs(fake_bucket, monkeypatch, tmp_path):
+    """The data-prep GCS branch: wipe-and-recreate the destination via
+    epath, stage shards to /tmp, upload — then the uploaded bucket must
+    be directly consumable by the gs:// reader."""
+    import etils.epath
+
+    from progen_tpu.data import fasta as fasta_mod
+    from progen_tpu.data.tfrecord import iterator_from_tfrecords_folder
+
+    real_path_cls = etils.epath.Path
+    monkeypatch.setattr(
+        etils.epath, "Path",
+        lambda p, *parts: real_path_cls(fake_bucket.to_local(p), *parts),
+    )
+
+    fasta_file = tmp_path / "mini.fasta"
+    fasta_file.write_text(
+        ">UniRef50_A n=1 Tax=TestTax TaxID=1\nMKVVAA\n"
+        ">UniRef50_B n=1\nQQERST\n"
+    )
+    url_dir = "gs://fake-bucket/prepped"
+    # pre-populate stale content that the wipe branch must remove
+    stale = Path(fake_bucket.to_local(f"{url_dir}/stale.txt"))
+    stale.write_text("old")
+
+    counts = fasta_mod.generate_tfrecords(
+        str(fasta_file), url_dir, num_samples=2, max_seq_len=32,
+        fraction_valid_data=0.5, num_sequences_per_file=1, seed=1,
+        num_workers=1,
+    )
+    assert counts["train"] >= 1 and counts["valid"] >= 1
+    assert not stale.exists()
+
+    total, get_it = iterator_from_tfrecords_folder(url_dir, "train")
+    assert total == counts["train"]
+    batch = next(get_it(seq_len=16, batch_size=1))
+    assert batch.shape == (1, 17) and batch[0, 0] == 0 and batch[0, 1] > 0
