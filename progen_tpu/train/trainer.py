@@ -198,7 +198,11 @@ class Trainer:
             ),
         )
         self.keys = KeySeq(cfg.seed)
-        self.meter = ThroughputMeter()
+        # 12 sync intervals (~300 steps at log_every 25): long enough to
+        # be "sustained", short enough that the logged rate actually
+        # slides past cold-start artifacts instead of averaging over the
+        # whole run forever
+        self.meter = ThroughputMeter(window=12)
         # Preemption safety (TPU VMs are preemptible; the reference's only
         # fault story is its periodic checkpoint): single-process runs get
         # a SIGTERM handler that requests a checkpoint at the next step
