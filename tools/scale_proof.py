@@ -54,7 +54,10 @@ N_PROC = 8
 
 def coordinate(args) -> int:
     workdir = tempfile.mkdtemp(prefix=f"scale_proof_{args.config}_")
-    port = 12123
+    # fresh port per invocation: a lingering worker from a killed previous
+    # run on the same port poisons the coordination service ("connected
+    # with a different incarnation")
+    port = 20000 + os.getpid() % 20000
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     for var in ("PALLAS_AXON_POOL_IPS", "TPU_WORKER_HOSTNAMES"):
