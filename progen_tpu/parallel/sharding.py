@@ -46,6 +46,10 @@ RULE_SETS: dict[str, list[tuple[str, Any]]] = {
         ("embed", "fsdp"),
         ("vocab", "fsdp"),
         ("spatial_row", "fsdp"),
+        # the SGU projection's input axis — without this the one kernel
+        # whose axes are (mlp_in, mlp) dodged ZeRO-3 entirely (caught by
+        # the scale proof's per-device byte audit at base scale)
+        ("mlp_in", "fsdp"),
     ],
     "tp": [
         ("act_batch", ("data", "fsdp")),
