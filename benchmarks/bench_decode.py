@@ -1,0 +1,83 @@
+"""Autoregressive decode throughput (cached scan sampler).
+
+The reference samples by re-running a FULL forward over the whole padded
+sequence per generated token (``/root/reference/progen_transformer/
+utils.py:106-135``) — O(L) jitted full-sequence forwards.  This
+framework's sampler is one ``lax.scan`` of cached single-token steps
+(O(window) attention per token); this bench reports its tokens/sec so
+the decode path has a number, not just an asymptotic claim.
+
+Timing wraps a host transfer of the sampled ids (the only trustworthy
+sync on the tunneled chip).  Usage::
+
+    python benchmarks/bench_decode.py [--config small] [--length 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="small")
+    ap.add_argument("--length", type=int, default=1024)
+    ap.add_argument("--prime", type=int, default=32)
+    ap.add_argument("--batches", type=int, default=(1, 8), nargs="+")
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from progen_tpu.core.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.decode import make_sampler
+    from progen_tpu.models import ProGen
+    from progen_tpu.models.configs import CONFIGS
+    from progen_tpu.parallel import unbox
+
+    cfg = CONFIGS[args.config]
+    length = min(args.length, cfg.seq_len)
+    policy = make_policy(True)
+    model = ProGen(config=cfg, policy=policy)
+    toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
+    params = unbox(jax.jit(model.init)(jax.random.key(0), toks))["params"]
+    sampler = make_sampler(cfg, policy)
+
+    rng = np.random.default_rng(0)
+    for b in args.batches:
+        prime = jnp.asarray(
+            rng.integers(1, cfg.num_tokens, (b, args.prime)), jnp.int32)
+        run = lambda k: np.asarray(sampler(
+            {"params": params}, k, prime, length=length, top_k=25,
+            add_bos=True))
+        run(jax.random.key(1))  # compile + warm
+        times = []
+        for r in range(args.reps):
+            t0 = time.perf_counter()
+            run(jax.random.key(r))
+            times.append(time.perf_counter() - t0)
+        med = statistics.median(times)
+        new_tokens = b * (length - args.prime - 1)
+        print(
+            f"config={args.config} batch={b} length={length} "
+            f"prime={args.prime}: {med:.3f}s/seq-batch, "
+            f"{new_tokens / med:,.0f} sampled tokens/sec, "
+            f"{med / (length - args.prime - 1) * 1e3:.2f} ms/token",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
