@@ -68,7 +68,10 @@ def coordinate(args) -> int:
     ]
     flags.append("--xla_force_host_platform_device_count=1")
     env["XLA_FLAGS"] = " ".join(flags)
-    env["PROGEN_COMPILE_CACHE"] = os.path.join(workdir, "xla_cache")
+    # shared across invocations: reruns (and the other 7 workers) hit the
+    # persistent cache instead of repeating a ~30-minute base compile
+    env["PROGEN_COMPILE_CACHE"] = os.path.expanduser(
+        "~/.cache/progen_tpu/xla_scale_proof")
 
     workers = [
         subprocess.Popen(
