@@ -82,3 +82,32 @@ def test_sharded_sampler_short_decode(devices8, setup):
                           params_shardings=shardings)
     got = sample({"params": sharded_params}, key, prime, **kw)
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_large_sharded_sampler_lowers_at_real_shapes(devices8):
+    """ProGen-large (1.35B) sharded decode traces + SPMD-lowers at its
+    real dims on the fsdp x tp mesh (shape/sharding validation at the
+    scale where one-chip decode is impossible; execution at these dims
+    is exercised on real hardware, not in CI)."""
+    import jax.numpy as jnp
+
+    from progen_tpu.models import ProGen
+    from progen_tpu.models.configs import LARGE
+    from progen_tpu.core.precision import make_policy
+
+    policy = make_policy(True)
+    mesh = make_mesh(MeshConfig(data=1, fsdp=4, tensor=2), devices=jax.devices())
+    model = ProGen(config=LARGE, policy=policy)
+    tokens = jnp.zeros((1, LARGE.seq_len), jnp.int32)
+    shardings = param_shardings(model, tokens, mesh, ("fsdp", "tp"))["params"]
+    sample = make_sampler(LARGE, policy, mesh=mesh, strategies=("fsdp", "tp"),
+                          params_shardings=shardings)
+    abstract = jax.eval_shape(
+        lambda k: unbox(model.init(k, tokens))["params"], jax.random.key(0))
+    abstract = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abstract, shardings)
+    prime = jax.ShapeDtypeStruct((1, 32), jnp.int32)
+    lowered = sample.lower({"params": abstract}, jax.random.key(0), prime,
+                           length=128, top_k=25, add_bos=True)
+    assert lowered is not None
