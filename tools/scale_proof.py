@@ -152,6 +152,35 @@ def _stagger(pid: int, workdir: str, tag: str, compile_fn) -> float:
     return time.time() - t0
 
 
+def _warm_collectives(mesh) -> None:
+    """Create every gloo communicator the sharded step will use, NOW,
+    while all processes are barrier-synced.
+
+    Gloo builds a context per device clique lazily at the clique's first
+    collective, with a 30s peer-arrival window (a hardcoded
+    GetKeyValue timeout).  Inside a minutes-long train step the 8
+    timesharing processes drift far past 30s, so first-use there dies
+    with DEADLINE_EXCEEDED; the client caches communicators per clique,
+    so touching each clique with a tiny psum here makes the real step
+    pure reuse."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    names = mesh.axis_names
+    axis_sets = [
+        ("fsdp",), ("tensor",), ("data",), ("seq",),
+        ("data", "fsdp"), ("fsdp", "tensor"), tuple(names),
+    ]
+    for axes in axis_sets:
+        f = shard_map(
+            lambda x: jax.lax.psum(x, axes),
+            mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False,
+        )
+        jax.block_until_ready(jax.jit(f)(jnp.ones((8,), jnp.float32)))
+
+
 def worker(args) -> int:
     pid, workdir = args.worker, args.workdir
     sys.path.insert(0, REPO)
@@ -234,6 +263,8 @@ def worker(args) -> int:
         lambda: fns.train_step.lower(abstract, batch_shape).compile()), 1)
     log(f"compiles done (init {common['compile_init_seconds']}s, "
         f"step {common['compile_step_seconds']}s)")
+    _warm_collectives(mesh)
+    log("collective cliques warmed")
 
     t0 = time.time()
     state = fns.init_state(key)
@@ -303,6 +334,7 @@ def worker(args) -> int:
         lambda: fns2.train_step.lower(abstract2, batch_shape).compile()), 1)
 
     _barrier("pre_restore")
+    _warm_collectives(mesh2)
     t0 = time.time()
     restored = store.restore_state(abstract2)
     jax.block_until_ready(restored.params)
