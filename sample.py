@@ -72,7 +72,8 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
             raise click.BadParameter(str(e), param_hint="--mesh")
         # restore each shard straight to its device — no host ever holds
         # the full state (the whole point for >1-chip models)
-        param_sh = param_shardings(model, sample_tokens, mesh, strategy_list)
+        param_sh = param_shardings(
+            model, sample_tokens, mesh, strategy_list)["params"]
     params = store.restore_params(
         abstract_params_like(model, sample_tokens, shardings=param_sh))
     store.close()

@@ -165,7 +165,7 @@ def _warm_collectives(mesh) -> None:
     pure reuse."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     names = mesh.axis_names
@@ -176,7 +176,7 @@ def _warm_collectives(mesh) -> None:
     for axes in axis_sets:
         f = shard_map(
             lambda x: jax.lax.psum(x, axes),
-            mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False,
+            mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False,
         )
         jax.block_until_ready(jax.jit(f)(jnp.ones((8,), jnp.float32)))
 
