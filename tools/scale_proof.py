@@ -53,7 +53,18 @@ N_PROC = 8
 
 
 def coordinate(args) -> int:
+    if args.phase == "3" and not args.ckpt:
+        print("--phase 3 needs --ckpt (the phase-1 run's saved checkpoint; "
+              "its workdir is printed at launch)", file=sys.stderr)
+        return 2
+    if args.ckpt and args.phase != "3":
+        # phase 1 would save INTO --ckpt with keep_last_n=1, pruning a
+        # user-supplied directory down to one step — refuse
+        print("--ckpt is only valid with --phase 3", file=sys.stderr)
+        return 2
     workdir = tempfile.mkdtemp(prefix=f"scale_proof_{args.config}_")
+    print(f"[scale_proof] workdir {workdir} (phase-1 checkpoint lands in "
+          f"{workdir}/ckpt)", flush=True)
     # fresh port per invocation: a lingering worker from a killed previous
     # run on the same port poisons the coordination service ("connected
     # with a different incarnation")
@@ -77,9 +88,10 @@ def coordinate(args) -> int:
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__),
              "--config", args.config, "--batch", str(args.batch),
-             "--steps", str(args.steps),
+             "--steps", str(args.steps), "--phase", args.phase,
              "--worker", str(pid), "--workdir", workdir,
-             "--port", str(port)],
+             "--port", str(port)]
+            + (["--ckpt", args.ckpt] if args.ckpt else []),
             env=env, cwd=REPO,
         )
         for pid in range(N_PROC)
@@ -87,28 +99,35 @@ def coordinate(args) -> int:
     rcs = [w.wait() for w in workers]
     if any(rcs):
         print(f"[scale_proof] worker rcs: {rcs}", file=sys.stderr)
-        return 1
+        # fall through: per-phase fragments flushed before a later crash
+        # are still worth merging
 
-    fragments = [
-        json.load(open(os.path.join(workdir, f"fragment_{pid}.json")))
-        for pid in range(N_PROC)
-    ]
-    report = fragments[0]["common"]
-    report["per_device_param_bytes"] = {
-        k: v for f in fragments for k, v in f["param_bytes"].items()
-    }
-    report["per_device_opt_state_bytes"] = {
-        k: v for f in fragments for k, v in f["opt_bytes"].items()
-    }
-    report["per_device_param_bytes_after_reshard"] = {
-        k: v for f in fragments for k, v in f["param_bytes_resharded"].items()
-    }
+    merged: dict = {}
+    byte_tables: dict[str, dict] = {}
+    for pid in range(N_PROC):
+        for tag in ("p1", "p3"):
+            frag = os.path.join(workdir, f"fragment_{tag}_{pid}.json")
+            if not os.path.exists(frag):
+                continue
+            f = json.load(open(frag))
+            merged.update(f.get("common", {}))
+            for key, table in f.get("bytes", {}).items():
+                byte_tables.setdefault(key, {}).update(table)
+    if not merged:
+        return 1
+    merged.update(byte_tables)
     out_path = os.path.join(REPO, "benchmarks",
                             f"scale_proof_{args.config}.json")
+    existing = json.load(open(out_path)) if os.path.exists(out_path) else {}
+    if existing and existing.get("batch") not in (None, args.batch):
+        # never silently mix runs at different shapes into one evidence
+        # file; keep the old one visible instead
+        existing = {"superseded_run": existing}
+    existing.update(merged)
     with open(out_path, "w") as fh:
-        json.dump(report, fh, indent=1)
+        json.dump(existing, fh, indent=1)
     print(f"[scale_proof] wrote {out_path}")
-    return 0
+    return 0 if not any(rcs) else 1
 
 
 # --------------------------------------------------------------------------
@@ -249,120 +268,142 @@ def worker(args) -> int:
         if pid == 0:
             print(f"[scale_proof] {msg}", flush=True)
 
-    # -- phase 1: fsdp=4 x tp=2 ---------------------------------------------
-    mesh, fns = build(MeshConfig(data=1, fsdp=4, tensor=2))
-    key = jax.random.key(0)
-    abstract = jax.eval_shape(fns.init_state, key)
+    def flush_fragment(tag: str, bytes_tables: dict) -> None:
+        # flushed per phase: a later OOM/crash cannot lose earlier evidence
+        path = os.path.join(workdir, f"fragment_{tag}_{pid}.json")
+        with open(path, "w") as fh:
+            json.dump({"common": common if pid == 0 else {},
+                       "bytes": bytes_tables}, fh)
+
+    # strict tolerance at the real scales; toy smoke configs are dominated
+    # by the SGU spatial weights (fsdp-sharded only, i.e. 4-way not 8) —
+    # at base scale those are <1% of params
+    tol = 1.06 if args.config in ("base", "large", "xl") else 3.0
+    total_param_bytes = None
     batch_shape = jax.ShapeDtypeStruct(
         (args.batch, cfg.seq_len + 1), jnp.int32)
-
-    common["compile_init_seconds"] = round(_stagger(
-        pid, workdir, "init1", lambda: fns.init_state.lower(key).compile()), 1)
-    common["compile_step_seconds"] = round(_stagger(
-        pid, workdir, "step1",
-        lambda: fns.train_step.lower(abstract, batch_shape).compile()), 1)
-    log(f"compiles done (init {common['compile_init_seconds']}s, "
-        f"step {common['compile_step_seconds']}s)")
-    _warm_collectives(mesh)
-    log("collective cliques warmed")
-
-    t0 = time.time()
-    state = fns.init_state(key)
-    jax.block_until_ready(state.params)
-    common["init_seconds"] = round(time.time() - t0, 1)
-
-    num_params = int(sum(x.size for x in jax.tree.leaves(state.params)))
-    common["num_params"] = num_params
-    param_bytes = _local_bytes(state.params)
-    opt_bytes = _local_bytes(state.opt_state)
-    # every device holds ~1/8 of the f32 params (4 bytes each).  Strict
-    # tolerance at the real scales; toy smoke configs are dominated by
-    # the SGU spatial weights (fsdp-sharded only, i.e. 4-way not 8) and
-    # get a loose bound — at base scale those are <1% of params.
-    total_param_bytes = 4 * num_params
-    tol = 1.06 if args.config in ("base", "large", "xl") else 3.0
-    assert max(param_bytes.values()) < total_param_bytes / N_PROC * tol, (
-        f"param sharding uneven on {pid}: {param_bytes} vs "
-        f"{total_param_bytes}/{N_PROC}"
-    )
-
-    if pid == 0:
-        leaves = [
-            ("/".join(str(k.key) for k in path), leaf)
-            for path, leaf in
-            jax.tree_util.tree_flatten_with_path(state.params)[0]
-        ]
-        leaves.sort(key=lambda kv: -kv[1].size)
-        common["largest_param_shards"] = [
-            {
-                "name": name,
-                "global_shape": list(leaf.shape),
-                "shard_shape": list(leaf.addressable_shards[0].data.shape),
-            }
-            for name, leaf in leaves[:5]
-        ]
-
-    batch = global_batch(mesh)
-    t0 = time.time()
-    for _ in range(args.steps):
-        state, metrics = fns.train_step(state, batch)
-    loss1 = float(metrics["loss"])
-    common["step_seconds_fsdp4_tp2"] = round((time.time() - t0) / args.steps, 1)
-    common["loss_fsdp4_tp2"] = loss1
-    assert np.isfinite(loss1), f"non-finite loss {loss1}"
-    log(f"fsdp=4,tp=2 step ok: loss={loss1:.4f} "
-        f"({common['step_seconds_fsdp4_tp2']}s/step)")
-
-    # -- phase 2: cooperative sharded save ----------------------------------
-    _barrier("pre_save")
-    ckpt_dir = os.path.join(workdir, "ckpt")
+    ckpt_dir = args.ckpt or os.path.join(workdir, "ckpt")
     store = CheckpointStore(ckpt_dir, keep_last_n=1)
-    t0 = time.time()
-    store.save(args.steps, state, next_seq_index=args.batch * args.steps,
-               model_config=cfg.to_dict())
-    store.wait_until_finished()
-    common["save_seconds"] = round(time.time() - t0, 1)
-    log(f"cooperative save done ({common['save_seconds']}s)")
 
-    del state, metrics, batch
+    # -- phase 1: fsdp=4 x tp=2 ---------------------------------------------
+    if args.phase in ("all", "1"):
+        mesh, fns = build(MeshConfig(data=1, fsdp=4, tensor=2))
+        key = jax.random.key(0)
+        abstract = jax.eval_shape(fns.init_state, key)
+        common["compile_init_seconds"] = round(_stagger(
+            pid, workdir, "init1",
+            lambda: fns.init_state.lower(key).compile()), 1)
+        common["compile_step_seconds"] = round(_stagger(
+            pid, workdir, "step1",
+            lambda: fns.train_step.lower(abstract, batch_shape).compile()), 1)
+        log(f"compiles done (init {common['compile_init_seconds']}s, "
+            f"step {common['compile_step_seconds']}s)")
+        _warm_collectives(mesh)
+        log("collective cliques warmed")
+
+        t0 = time.time()
+        state = fns.init_state(key)
+        jax.block_until_ready(state.params)
+        common["init_seconds"] = round(time.time() - t0, 1)
+
+        num_params = int(sum(x.size for x in jax.tree.leaves(state.params)))
+        common["num_params"] = num_params
+        param_bytes = _local_bytes(state.params)
+        opt_bytes = _local_bytes(state.opt_state)
+        # every device holds ~1/8 of the f32 params (4 bytes each).  Strict
+        # tolerance at the real scales; toy smoke configs are dominated by
+        # the SGU spatial weights (fsdp-sharded only, i.e. 4-way not 8) and
+        # get a loose bound — at base scale those are <1% of params.
+        total_param_bytes = 4 * num_params
+        assert max(param_bytes.values()) < total_param_bytes / N_PROC * tol, (
+            f"param sharding uneven on {pid}: {param_bytes} vs "
+            f"{total_param_bytes}/{N_PROC}"
+        )
+
+        if pid == 0:
+            leaves = [
+                ("/".join(str(k.key) for k in path), leaf)
+                for path, leaf in
+                jax.tree_util.tree_flatten_with_path(state.params)[0]
+            ]
+            leaves.sort(key=lambda kv: -kv[1].size)
+            common["largest_param_shards"] = [
+                {
+                    "name": name,
+                    "global_shape": list(leaf.shape),
+                    "shard_shape": list(leaf.addressable_shards[0].data.shape),
+                }
+                for name, leaf in leaves[:5]
+            ]
+
+        batch = global_batch(mesh)
+        t0 = time.time()
+        for _ in range(args.steps):
+            state, metrics = fns.train_step(state, batch)
+        loss1 = float(metrics["loss"])
+        common["step_seconds_fsdp4_tp2"] = round((time.time() - t0) / args.steps, 1)
+        common["loss_fsdp4_tp2"] = loss1
+        assert np.isfinite(loss1), f"non-finite loss {loss1}"
+        log(f"fsdp=4,tp=2 step ok: loss={loss1:.4f} "
+            f"({common['step_seconds_fsdp4_tp2']}s/step)")
+
+        # -- phase 2: cooperative sharded save ----------------------------------
+        _barrier("pre_save")
+        t0 = time.time()
+        store.save(args.steps, state, next_seq_index=args.batch * args.steps,
+                   model_config=cfg.to_dict())
+        store.wait_until_finished()
+        common["save_seconds"] = round(time.time() - t0, 1)
+        log(f"cooperative save done ({common['save_seconds']}s)")
+
+        flush_fragment("p1", {
+            "per_device_param_bytes": param_bytes,
+            "per_device_opt_state_bytes": opt_bytes,
+        })
+        del state, metrics, batch
 
     # -- phase 3: restore onto a DIFFERENT topology, step again -------------
-    mesh2, fns2 = build(MeshConfig(data=2, fsdp=2, tensor=2))
-    abstract2 = abstract_state_like(fns2)
-    common["compile_step2_seconds"] = round(_stagger(
-        pid, workdir, "step2",
-        lambda: fns2.train_step.lower(abstract2, batch_shape).compile()), 1)
+    if args.phase in ("all", "3"):
+        mesh2, fns2 = build(MeshConfig(data=2, fsdp=2, tensor=2))
+        abstract2 = abstract_state_like(fns2)
+        if total_param_bytes is None:
+            total_param_bytes = 4 * int(sum(
+                x.size for x in jax.tree.leaves(abstract2.params)))
+        common["compile_step2_seconds"] = round(_stagger(
+            pid, workdir, "step2",
+            lambda: fns2.train_step.lower(abstract2, batch_shape).compile()),
+            1)
 
-    _barrier("pre_restore")
-    _warm_collectives(mesh2)
-    t0 = time.time()
-    restored = store.restore_state(abstract2)
-    jax.block_until_ready(restored.params)
-    common["restore_seconds_data2_fsdp2_tp2"] = round(time.time() - t0, 1)
-    assert int(restored.step) == args.steps
+        _barrier("pre_restore")
+        _warm_collectives(mesh2)
+        t0 = time.time()
+        restored = store.restore_state(abstract2)
+        assert restored is not None, f"no checkpoint found in {ckpt_dir}"
+        jax.block_until_ready(restored.params)
+        common["restore_seconds_data2_fsdp2_tp2"] = round(time.time() - t0, 1)
+        # an external --ckpt may hold any step; the invariant is that the
+        # restore landed on the step the STORE says is newest
+        assert int(restored.step) == store.latest_step()
 
-    param_bytes_resharded = _local_bytes(restored.params)
-    # fsdp=2 x tp=2 -> each device holds ~1/4
-    assert max(param_bytes_resharded.values()) < total_param_bytes / 4 * tol
+        param_bytes_resharded = _local_bytes(restored.params)
+        # fsdp=2 x tp=2 -> each device holds ~1/4
+        assert max(param_bytes_resharded.values()) < (
+            total_param_bytes / 4 * tol)
 
-    batch2 = global_batch(mesh2)
-    t0 = time.time()
-    restored, metrics2 = fns2.train_step(restored, batch2)
-    loss2 = float(metrics2["loss"])
-    common["step_seconds_data2_fsdp2_tp2"] = round(time.time() - t0, 1)
-    common["loss_after_restore"] = loss2
-    assert np.isfinite(loss2)
-    log(f"data=2,fsdp=2,tp=2 restored step ok: loss={loss2:.4f}")
+        batch2 = global_batch(mesh2)
+        t0 = time.time()
+        restored, metrics2 = fns2.train_step(restored, batch2)
+        loss2 = float(metrics2["loss"])
+        common["step_seconds_data2_fsdp2_tp2"] = round(time.time() - t0, 1)
+        common["loss_after_restore"] = loss2
+        assert np.isfinite(loss2)
+        log(f"data=2,fsdp=2,tp=2 restored step ok: loss={loss2:.4f}")
+
+        flush_fragment("p3", {
+            "per_device_param_bytes_after_reshard": param_bytes_resharded,
+        })
 
     store.close()
-
-    with open(os.path.join(workdir, f"fragment_{pid}.json"), "w") as fh:
-        json.dump({
-            "common": common,
-            "param_bytes": param_bytes,
-            "opt_bytes": opt_bytes,
-            "param_bytes_resharded": param_bytes_resharded,
-        }, fh)
     return 0
 
 
@@ -375,6 +416,13 @@ def main() -> int:
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--steps", type=int, default=1,
                         help="train steps before the save")
+    parser.add_argument("--phase", default="all", choices=["all", "1", "3"],
+                        help="run only the init+step+save phase (1) or only "
+                             "the restore+step phase (3, with --ckpt); "
+                             "fragments flush per phase so a crash in one "
+                             "never loses the other's evidence")
+    parser.add_argument("--ckpt", default=None,
+                        help="existing sharded checkpoint dir for --phase 3")
     parser.add_argument("--worker", type=int, default=None)
     parser.add_argument("--workdir", default=None)
     parser.add_argument("--port", type=int, default=12123)
