@@ -31,6 +31,7 @@ def main(data_dir, name, seed, num_workers):
         num_sequences_per_file=config.get("num_sequences_per_file", 1000),
         prob_invert_seq_annotation=config.get("prob_invert_seq_annotation", 0.5),
         sort_annotations=config.get("sort_annotations", True),
+        annotations=tuple(config.get("annotations", ["tax"])),
         seed=seed,
         num_workers=num_workers,
     )

@@ -110,7 +110,10 @@ class CheckpointStore:
         in the background; readers and :meth:`close` wait for it.
         """
         mgr = self._manager()
-        if step == mgr.latest_step():
+        # membership, not latest_step(): re-converting a reference pickle
+        # into a store that has trained past step 0 collides with a step
+        # that exists but is no longer the newest
+        if step in mgr.all_steps():
             if not overwrite:
                 return False
             mgr.wait_until_finished()

@@ -39,6 +39,32 @@ import numpy as np
 from progen_tpu.data.tfrecord import shard_filename, write_tfrecord
 
 TAX_RE = re.compile(r"Tax=([a-zA-Z\s]*)\s[a-zA-Z\=]")
+# GO terms as they appear in UniProt/UniRef-derived descriptions: the
+# canonical 7-digit accession ``GO:0016021``, one or many (e.g. a custom
+# export's ``GO=GO:0016021; GO:0005886`` field).  The reference extracts
+# only Tax= (/root/reference/generate_data.py:36-43); GO conditioning is
+# the BASELINE.json ProGen-large capability ("+ GO annotation
+# conditioning") the same mechanism extends to.
+GO_RE = re.compile(r"(?<!\d)GO:(\d{7})(?!\d)")  # digit-bounded: GO:00160215 is NOT a GO term
+
+
+def _extract_tax(description: str) -> str | None:
+    m = TAX_RE.findall(description)
+    return m[0] if m else None
+
+
+def _extract_go(description: str) -> str | None:
+    terms = GO_RE.findall(description)
+    if not terms:
+        return None
+    # deduplicate, keep first-seen order: "GO:0016021,GO:0005886"
+    seen = dict.fromkeys(terms)
+    return ",".join(f"GO:{t}" for t in seen)
+
+
+# config-driven extractor set: each key becomes a ``[key=value]`` prefix
+# token when its extractor finds a value in the FASTA description
+EXTRACTORS = {"tax": _extract_tax, "go": _extract_go}
 
 
 def parse_fasta(path: str) -> Iterator[tuple[str, str]]:
@@ -64,9 +90,20 @@ def parse_fasta(path: str) -> Iterator[tuple[str, str]]:
             yield desc, "".join(chunks).upper()
 
 
-def annotations_from_description(description: str) -> dict[str, str]:
-    m = TAX_RE.findall(description)
-    return {"tax": m[0]} if m else {}
+def annotations_from_description(
+    description: str, annotations: tuple[str, ...] = ("tax",)
+) -> dict[str, str]:
+    """Extract the requested annotation keys from a FASTA description.
+
+    ``annotations`` selects from :data:`EXTRACTORS` (``"tax"``, ``"go"``);
+    the default matches the reference's Tax-only behavior
+    (``/root/reference/generate_data.py:36-43``)."""
+    out = {}
+    for key in annotations:
+        value = EXTRACTORS[key](description)
+        if value is not None:
+            out[key] = value
+    return out
 
 
 def sequence_strings(
@@ -75,10 +112,17 @@ def sequence_strings(
     rng: np.random.Generator,
     prob_invert: float = 0.5,
     sort_annotations: bool = True,
+    annotation_keys: tuple[str, ...] = ("tax",),
 ) -> list[bytes]:
-    """1-2 encoded training strings per FASTA record (reference ``:45-74``)."""
+    """1-2 encoded training strings per FASTA record (reference ``:45-74``).
+
+    With multiple annotation keys the prefix is multi-token, e.g.
+    ``"[go=GO:0016021] [tax=Escherichia coli] # SEQ"`` — same sort/invert
+    semantics as the reference's single-key case (sorted keys unless
+    ``sort_annotations=False`` shuffles them; the whole annotation block
+    swaps sides with the sequence with probability ``prob_invert``)."""
     out: list[bytes] = []
-    annotations = annotations_from_description(description)
+    annotations = annotations_from_description(description, annotation_keys)
     if annotations:
         keys = sorted(annotations) if sort_annotations else list(annotations)
         if not sort_annotations:
@@ -99,9 +143,10 @@ def _format_record(args: tuple) -> list[bytes]:
     deterministic and IDENTICAL regardless of worker count or scheduling
     (the serial path uses the same derivation).
     """
-    idx, desc, seq, prob_invert, sort_annotations, seed = args
+    idx, desc, seq, prob_invert, sort_annotations, annotation_keys, seed = args
     rng = np.random.default_rng([seed, idx])
-    return sequence_strings(desc, seq, rng, prob_invert, sort_annotations)
+    return sequence_strings(desc, seq, rng, prob_invert, sort_annotations,
+                            annotation_keys)
 
 
 def _filtered_records(
@@ -127,10 +172,15 @@ def generate_tfrecords(
     num_sequences_per_file: int = 1000,
     prob_invert_seq_annotation: float = 0.5,
     sort_annotations: bool = True,
+    annotations: tuple[str, ...] = ("tax",),
     seed: int = 0,
     num_workers: int | None = None,
 ) -> dict[str, int]:
     """Run the full prep: returns ``{"train": n, "valid": m}`` counts.
+
+    ``annotations``: which :data:`EXTRACTORS` keys to mine from each FASTA
+    description (default Tax-only, the reference behavior; add ``"go"``
+    for GO-term conditioning — BASELINE.json's ProGen-large capability).
 
     ``num_workers``: size of the ``multiprocessing`` pool used for record
     formatting and shard compression (the reference README's "utilize all
@@ -159,9 +209,14 @@ def generate_tfrecords(
     offsets: list[int] = []
     lengths: list[int] = []
     with tempfile.TemporaryFile() as spool:
+        unknown = set(annotations) - set(EXTRACTORS)
+        if unknown:
+            raise ValueError(
+                f"unknown annotation keys {sorted(unknown)}; "
+                f"available: {sorted(EXTRACTORS)}")
         args = (
             (idx, desc, seq, prob_invert_seq_annotation, sort_annotations,
-             seed)
+             tuple(annotations), seed)
             for idx, desc, seq in _filtered_records(
                 read_from, max_seq_len, num_samples)
         )

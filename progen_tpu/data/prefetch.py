@@ -94,7 +94,14 @@ class DevicePrefetcher:
         return item
 
     def close(self) -> None:
-        """Stop the worker and drop buffered batches (idempotent)."""
+        """Stop the worker and drop buffered batches (idempotent).
+
+        The wrapped iterator is OWNED by the prefetcher from construction
+        on: the worker may be blocked inside ``next(iterator)`` (e.g.
+        tf.data waiting on a slow source), in which case it survives the
+        bounded join as an orphaned daemon and may still consume one more
+        item when the source unblocks.  Never hand the underlying iterator
+        to another consumer after wrapping it."""
         self._stop.set()
         while True:
             try:
@@ -102,3 +109,13 @@ class DevicePrefetcher:
             except queue.Empty:
                 break
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            import warnings
+
+            warnings.warn(
+                "DevicePrefetcher worker did not exit within 5s (blocked in "
+                "next() on the wrapped iterator?); it remains attached to "
+                "the iterator and may consume one more batch",
+                RuntimeWarning,
+                stacklevel=2,
+            )

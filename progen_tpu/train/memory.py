@@ -42,6 +42,12 @@ GiB = 1024**3
 # fitted to v5e memory_analysis measurements (benchmarks/memory_plan.md)
 ACT_EFFICIENCY = {"none": 0.82, "dots": 0.91, "full": 1.0, "attn": 1.0}
 
+# device kinds the peak model was actually validated on (8 calibration
+# points incl. the OOM boundaries, benchmarks/memory_plan.md); on other
+# generations XLA's scheduler may assign buffers differently, so the fit
+# gate must not hard-block runs it has never been checked against
+CALIBRATED_DEVICE_KINDS = frozenset({"TPU v5e", "TPU v5 lite"})
+
 
 @dataclasses.dataclass(frozen=True)
 class MemoryPlan:
@@ -248,10 +254,17 @@ def plan(
 
 
 def device_hbm_bytes(device=None) -> int | None:
-    """Usable HBM of the local accelerator, or None when unknown."""
+    """Usable HBM of the local accelerator, or None when unknown.
+
+    Defaults to ``jax.local_devices()[0]``: in a multi-process run
+    ``jax.devices()[0]`` is the globally-first device, which is
+    non-addressable on every host but process 0 — ``memory_stats()`` would
+    raise there and the fit gate would silently pass on those hosts while
+    process 0 alone raised, leaving the fleet hung in collective init
+    instead of failing together."""
     import jax
 
-    device = device or jax.devices()[0]
+    device = device or jax.local_devices()[0]
     if device.platform != "tpu":
         return None
     try:
@@ -262,13 +275,35 @@ def device_hbm_bytes(device=None) -> int | None:
 
 
 def check_fits(plan_: MemoryPlan, hbm_bytes: int | None,
-               headroom: float = 0.02) -> str | None:
+               headroom: float = 0.02,
+               device_kind: str | None = None) -> str | None:
     """None when the plan fits; otherwise a multi-line error message with
-    the breakdown and the knobs most likely to make it fit."""
+    the breakdown and the knobs most likely to make it fit.
+
+    When ``device_kind`` is given and is NOT in
+    :data:`CALIBRATED_DEVICE_KINDS`, an over-budget prediction degrades to
+    a warning instead of an error: the peak model has only been validated
+    against v5e buffer assignment, and hard-blocking a run on an
+    uncalibrated generation would turn a model-fit question into a bad
+    first-run experience on new hardware."""
     if hbm_bytes is None:
         return None
     budget = hbm_bytes * (1 - headroom)
     if plan_.total_bytes <= budget:
+        return None
+    if device_kind is not None and device_kind not in CALIBRATED_DEVICE_KINDS:
+        import warnings
+
+        warnings.warn(
+            f"memory plan predicts {plan_.total_bytes / GiB:.2f} GiB > "
+            f"{hbm_bytes / GiB:.2f} GiB HBM, but the planner is calibrated "
+            f"only on {sorted(CALIBRATED_DEVICE_KINDS)} "
+            f"(benchmarks/memory_plan.md), not {device_kind!r} — "
+            "proceeding; if the compile ends in RESOURCE_EXHAUSTED, apply "
+            "the plan's suggestions or set PROGEN_SKIP_MEMORY_CHECK=1",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return None
     suggestions = []
     if (plan_.snapshot_bytes
@@ -299,6 +334,9 @@ def check_fits(plan_: MemoryPlan, hbm_bytes: int | None,
         )
     return (
         f"predicted per-chip HBM {plan_.total_bytes / GiB:.2f} GiB exceeds "
-        f"the chip's {hbm_bytes / GiB:.2f} GiB:\n{plan_.report()}\n"
+        f"the chip's {hbm_bytes / GiB:.2f} GiB (planner calibrated on "
+        f"{sorted(CALIBRATED_DEVICE_KINDS)}, benchmarks/memory_plan.md; "
+        "PROGEN_SKIP_MEMORY_CHECK=1 overrides):\n"
+        f"{plan_.report()}\n"
         "try: " + "; ".join(suggestions or ["a bigger mesh"])
     )

@@ -171,7 +171,9 @@ class Trainer:
                 checkpoint_snapshot=(cfg.background_checkpoint
                                      and jax.process_count() == 1),
             )
-            err = check_fits(self.memory_plan, device_hbm_bytes())
+            gate_device = jax.local_devices()[0]
+            err = check_fits(self.memory_plan, device_hbm_bytes(gate_device),
+                             device_kind=gate_device.device_kind)
             if err is not None:
                 raise ValueError(err)
 
@@ -261,8 +263,15 @@ class Trainer:
             )
 
         st = abstract(state)
+        # the REAL batch is global — cfg.batch_size rows per host assembled
+        # via make_array_from_process_local_data (_to_device) — so the warm
+        # program must match that shape+sharding or multi-host runs (the
+        # ones that compile slowest) still compile cold at step 1
         batch = jax.ShapeDtypeStruct(
-            (cfg.batch_size, self.model_config.seq_len + 1), jnp.int32
+            (cfg.batch_size * jax.process_count(),
+             self.model_config.seq_len + 1),
+            jnp.int32,
+            sharding=self.data_sharding,
         )
         prime = jax.ShapeDtypeStruct((1, cfg.prime_length), jnp.int32)
         programs = [

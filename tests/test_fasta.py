@@ -70,6 +70,76 @@ def test_sequence_strings_conventions():
     assert out[0].startswith(b"MKLV # [tax=")
 
 
+def test_go_annotation_extraction():
+    """GO terms (BASELINE.json ProGen-large conditioning) come from the
+    config-driven extractor set; tax-only default is unchanged."""
+    desc = "membrane protein GO=GO:0016021; GO:0005886 Tax=Escherichia coli TaxID=562"
+    assert annotations_from_description(desc) == {"tax": "Escherichia coli"}
+    got = annotations_from_description(desc, ("tax", "go"))
+    assert got == {"tax": "Escherichia coli",
+                   "go": "GO:0016021,GO:0005886"}
+    # bare accessions, dedup, first-seen order
+    assert annotations_from_description(
+        "x GO:0008150 y GO:0008150 z GO:0003674", ("go",)
+    ) == {"go": "GO:0008150,GO:0003674"}
+    assert annotations_from_description("no terms", ("tax", "go")) == {}
+    # digit-bounded: 8+-digit accession-like tokens are not GO terms
+    assert annotations_from_description("x GO:00160215 y", ("go",)) == {}
+
+
+def test_multi_annotation_prefix_format():
+    """Multiple keys emit sorted '[go=...] [tax=...]' prefixes with the
+    reference's invert semantics applied to the whole annotation block."""
+    rng = np.random.default_rng(0)
+    desc = "x GO:0016021 Tax=Homo sapiens TaxID=9606"
+    out = sequence_strings(desc, "MKLV", rng, prob_invert=0.0,
+                           annotation_keys=("tax", "go"))
+    assert out[0] == b"[go=GO:0016021] [tax=Homo sapiens] # MKLV"
+    assert out[1] == b"# MKLV"
+    out = sequence_strings(desc, "MKLV", rng, prob_invert=1.0,
+                           annotation_keys=("tax", "go"))
+    assert out[0] == b"MKLV # [go=GO:0016021] [tax=Homo sapiens]"
+
+
+def test_go_prep_and_prime_roundtrip(tmp_path):
+    """Prep with annotations=("tax","go") and read back: the tfrecords must
+    contain the GO-conditioned strings, and the '[go=...]' prefix must
+    survive the tokenizer round-trip — i.e. it is a usable sampling prime."""
+    from progen_tpu.data import encode_tokens
+
+    lines = [
+        ">P1 membrane GO=GO:0016021; GO:0005886 Tax=Escherichia coli TaxID=562",
+        "MSKGEELFTG",
+        ">P2 plain protein",
+        "MKLVINLILA",
+    ]
+    p = tmp_path / "go.fasta"
+    p.write_text("\n".join(lines) + "\n")
+    counts = generate_tfrecords(
+        str(p), str(tmp_path / "rec"), fraction_valid_data=0.0,
+        prob_invert_seq_annotation=0.0, annotations=("tax", "go"), seed=0,
+    )
+    assert counts == {"train": 3, "valid": 0}  # P1 gets 2 strings, P2 gets 1
+
+    _, it_fn = iterator_from_tfrecords_folder(str(tmp_path / "rec"), "train")
+    rows = np.concatenate(list(it_fn(seq_len=96, batch_size=4)))
+    texts = {decode_tokens(r) for r in rows}
+    want = "[go=GO:0016021,GO:0005886] [tax=Escherichia coli] # MSKGEELFTG"
+    assert want in texts
+
+    # the conditioned prefix is a valid prime: encode -> decode is lossless
+    prime = "[go=GO:0016021] # "
+    assert decode_tokens(np.asarray(encode_tokens(prime))) == prime
+
+
+def test_unknown_annotation_key_rejected(tmp_path):
+    p = tmp_path / "x.fasta"
+    p.write_text(">P1 x\nMKLV\n")
+    with pytest.raises(ValueError, match="unknown annotation"):
+        generate_tfrecords(str(p), str(tmp_path / "rec"),
+                           annotations=("tax", "ec"))
+
+
 def test_generate_tfrecords_roundtrip(fasta_path, tmp_path):
     out_dir = tmp_path / "records"
     counts = generate_tfrecords(

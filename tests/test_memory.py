@@ -64,6 +64,31 @@ def test_check_fits_passes_and_fails_correctly():
     assert check_fits(oom, None) is None  # unknown HBM -> no gate
 
 
+def test_check_fits_uncalibrated_generation_warns_not_blocks():
+    """The peak model is fitted to v5e only; on an unknown chip generation
+    an over-budget prediction must degrade to a warning (a miscalibration
+    should not hard-block a valid run), while calibrated kinds still get
+    the hard error naming the calibration provenance."""
+    import pytest
+
+    v5e = int(15.75 * GiB)
+    oom = plan(CONFIGS["base"], batch_size=4, remat=True, remat_policy="dots")
+
+    with pytest.warns(RuntimeWarning, match="calibrated only on"):
+        assert check_fits(oom, v5e, device_kind="TPU v7x") is None
+
+    msg = check_fits(oom, v5e, device_kind="TPU v5e")
+    assert msg is not None and "memory_plan.md" in msg
+
+    # fitting plans never warn, whatever the generation
+    ok = plan(CONFIGS["small"], batch_size=8)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert check_fits(ok, v5e, device_kind="TPU v7x") is None
+
+
 def test_fsdp_and_tp_shrink_the_plan():
     cfg = CONFIGS["xl"]
     single = plan(cfg, batch_size=8, remat=True, remat_policy="dots")

@@ -53,14 +53,20 @@ N_PROC = 8
 
 
 def coordinate(args) -> int:
-    if args.phase == "3" and not args.ckpt:
-        print("--phase 3 needs --ckpt (the phase-1 run's saved checkpoint; "
-              "its workdir is printed at launch)", file=sys.stderr)
+    if args.phase in ("3", "sp") and not args.ckpt:
+        print(f"--phase {args.phase} needs --ckpt (the phase-1 run's saved "
+              "checkpoint; its workdir is printed at launch)", file=sys.stderr)
         return 2
-    if args.ckpt and args.phase != "3":
+    if args.ckpt and args.phase not in ("3", "sp"):
         # phase 1 would save INTO --ckpt with keep_last_n=1, pruning a
         # user-supplied directory down to one step — refuse
-        print("--ckpt is only valid with --phase 3", file=sys.stderr)
+        print("--ckpt is only valid with --phase 3 or sp", file=sys.stderr)
+        return 2
+    if args.skip_save and args.phase != "1":
+        # phase 3/sp restore the phase-1 save; letting --phase all skip it
+        # would burn the hours-long phase 1 and then die at restore
+        print("--skip-save is only valid with --phase 1 (later phases "
+              "restore that save)", file=sys.stderr)
         return 2
     workdir = tempfile.mkdtemp(prefix=f"scale_proof_{args.config}_")
     print(f"[scale_proof] workdir {workdir} (phase-1 checkpoint lands in "
@@ -91,7 +97,8 @@ def coordinate(args) -> int:
              "--steps", str(args.steps), "--phase", args.phase,
              "--worker", str(pid), "--workdir", workdir,
              "--port", str(port)]
-            + (["--ckpt", args.ckpt] if args.ckpt else []),
+            + (["--ckpt", args.ckpt] if args.ckpt else [])
+            + (["--skip-save"] if args.skip_save else []),
             env=env, cwd=REPO,
         )
         for pid in range(N_PROC)
@@ -105,7 +112,7 @@ def coordinate(args) -> int:
     merged: dict = {}
     byte_tables: dict[str, dict] = {}
     for pid in range(N_PROC):
-        for tag in ("p1", "p3"):
+        for tag in ("p1", "p3", "psp"):
             frag = os.path.join(workdir, f"fragment_{tag}_{pid}.json")
             if not os.path.exists(frag):
                 continue
@@ -124,6 +131,16 @@ def coordinate(args) -> int:
         # file; keep the old one visible instead
         existing = {"superseded_run": existing}
     existing.update(merged)
+    # sp parity verdict: the fsdp-only restored step and the seq-mesh
+    # restored step consumed the SAME checkpoint and the SAME batch, so
+    # their losses must agree (CP halo exchange + row-sharded SGU vs plain
+    # GSPMD).  bf16 matmuls under different reduction orders bound the
+    # tolerance.
+    if "loss_after_restore" in existing and "loss_after_restore_sp" in existing:
+        diff = abs(existing["loss_after_restore"]
+                   - existing["loss_after_restore_sp"])
+        existing["sp_vs_fsdp_loss_abs_diff"] = diff
+        existing["sp_loss_parity_ok"] = bool(diff < 5e-3)
     with open(out_path, "w") as fh:
         json.dump(existing, fh, indent=1)
     print(f"[scale_proof] wrote {out_path}")
@@ -240,17 +257,22 @@ def worker(args) -> int:
         "strategies": list(strategies),
         "mesh_phase1": "data=1,fsdp=4,tensor=2",
         "mesh_phase3": "data=2,fsdp=2,tensor=2",
+        "mesh_phase_sp": "data=1,fsdp=4,tensor=1,seq=2",
         "remat": "full",
     }
 
-    def build(mesh_cfg):
+    def build(mesh_cfg, phase_strategies=strategies):
         mesh = make_mesh(mesh_cfg)
+        # a seq axis >1 needs the model built mesh-aware so the forward
+        # routes through the shard_map CP ops (halo-exchange attention,
+        # row-sharded SGU) — GSPMD alone cannot shard the window structure
         model = ProGen(config=cfg, policy=make_policy(mixed_precision=True),
-                       remat=True, remat_policy="full")
+                       remat=True, remat_policy="full",
+                       mesh=mesh if "sp" in phase_strategies else None)
         sample = jnp.zeros((args.batch, cfg.seq_len), jnp.int32)
         fns = make_train_functions(
             model, make_optimizer(2e-4), sample, mesh=mesh,
-            strategies=strategies,
+            strategies=phase_strategies,
         )
         return mesh, fns
 
@@ -348,13 +370,23 @@ def worker(args) -> int:
             f"({common['step_seconds_fsdp4_tp2']}s/step)")
 
         # -- phase 2: cooperative sharded save ----------------------------------
-        _barrier("pre_save")
-        t0 = time.time()
-        store.save(args.steps, state, next_seq_index=args.batch * args.steps,
-                   model_config=cfg.to_dict())
-        store.wait_until_finished()
-        common["save_seconds"] = round(time.time() - t0, 1)
-        log(f"cooperative save done ({common['save_seconds']}s)")
+        if args.skip_save:
+            # XL's f32 state is ~77 GB; this box has 43 GB of disk — the
+            # executed-step evidence stands on its own, the save is
+            # physically impossible here, and saying so beats crashing
+            common["save_skipped"] = (
+                "--skip-save: sharded f32 state exceeds available disk on "
+                "this box; step evidence only")
+            log("save skipped (--skip-save)")
+        else:
+            _barrier("pre_save")
+            t0 = time.time()
+            store.save(args.steps, state,
+                       next_seq_index=args.batch * args.steps,
+                       model_config=cfg.to_dict())
+            store.wait_until_finished()
+            common["save_seconds"] = round(time.time() - t0, 1)
+            log(f"cooperative save done ({common['save_seconds']}s)")
 
         flush_fragment("p1", {
             "per_device_param_bytes": param_bytes,
@@ -403,6 +435,51 @@ def worker(args) -> int:
             "per_device_param_bytes_after_reshard": param_bytes_resharded,
         })
 
+    # -- phase sp: restore onto a SEQ mesh, step, record loss for parity ----
+    # The CP halo exchange and row-sharded SGU (parallel/context.py) had
+    # never run above seq 64; this executes them at the config's real
+    # seq_len.  Loss parity with phase 3 (same checkpoint, same batch) is
+    # asserted by the coordinator after the merge.
+    if args.phase == "sp":
+        mesh_sp, fns_sp = build(MeshConfig(data=1, fsdp=4, tensor=1, seq=2),
+                                phase_strategies=("sp", "fsdp"))
+        abstract_sp = abstract_state_like(fns_sp)
+        if total_param_bytes is None:
+            total_param_bytes = 4 * int(sum(
+                x.size for x in jax.tree.leaves(abstract_sp.params)))
+        common["compile_step_sp_seconds"] = round(_stagger(
+            pid, workdir, "stepsp",
+            lambda: fns_sp.train_step.lower(abstract_sp, batch_shape)
+            .compile()), 1)
+
+        _barrier("pre_restore_sp")
+        _warm_collectives(mesh_sp)
+        t0 = time.time()
+        restored = store.restore_state(abstract_sp)
+        assert restored is not None, f"no checkpoint found in {ckpt_dir}"
+        jax.block_until_ready(restored.params)
+        common["restore_seconds_sp"] = round(time.time() - t0, 1)
+        assert int(restored.step) == store.latest_step()
+
+        param_bytes_sp = _local_bytes(restored.params)
+        # params shard over fsdp=4 only (replicated across seq) -> ~1/4 each
+        assert max(param_bytes_sp.values()) < total_param_bytes / 4 * tol, (
+            f"param sharding uneven on {pid} (sp mesh): {param_bytes_sp}"
+        )
+
+        batch_sp = global_batch(mesh_sp)
+        t0 = time.time()
+        restored, metrics_sp = fns_sp.train_step(restored, batch_sp)
+        loss_sp = float(metrics_sp["loss"])
+        common["step_seconds_sp"] = round(time.time() - t0, 1)
+        common["loss_after_restore_sp"] = loss_sp
+        assert np.isfinite(loss_sp)
+        log(f"seq-mesh (fsdp=4,seq=2) restored step ok: loss={loss_sp:.4f}")
+
+        flush_fragment("psp", {
+            "per_device_param_bytes_sp_mesh": param_bytes_sp,
+        })
+
     store.close()
     return 0
 
@@ -416,13 +493,20 @@ def main() -> int:
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--steps", type=int, default=1,
                         help="train steps before the save")
-    parser.add_argument("--phase", default="all", choices=["all", "1", "3"],
-                        help="run only the init+step+save phase (1) or only "
-                             "the restore+step phase (3, with --ckpt); "
-                             "fragments flush per phase so a crash in one "
-                             "never loses the other's evidence")
+    parser.add_argument("--phase", default="all",
+                        choices=["all", "1", "3", "sp"],
+                        help="run only the init+step+save phase (1), only "
+                             "the restore+step phase (3, with --ckpt), or "
+                             "the seq-mesh restore+step phase (sp, with "
+                             "--ckpt; coordinator asserts loss parity with "
+                             "phase 3); fragments flush per phase so a "
+                             "crash in one never loses the other's evidence")
     parser.add_argument("--ckpt", default=None,
-                        help="existing sharded checkpoint dir for --phase 3")
+                        help="existing sharded checkpoint dir for "
+                             "--phase 3/sp")
+    parser.add_argument("--skip-save", action="store_true",
+                        help="phase 1 without the cooperative save (XL's "
+                             "state exceeds this box's disk)")
     parser.add_argument("--worker", type=int, default=None)
     parser.add_argument("--workdir", default=None)
     parser.add_argument("--port", type=int, default=12123)
