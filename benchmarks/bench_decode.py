@@ -11,6 +11,15 @@ Timing wraps a host transfer of the sampled ids (the only trustworthy
 sync on the tunneled chip).  Usage::
 
     python benchmarks/bench_decode.py [--config small] [--length 1024]
+
+Sharded decode (models too big for one chip, BASELINE's XL row) runs the
+same bench over a mesh — e.g. ProGen-large executed on the virtual
+8-device CPU mesh::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/bench_decode.py --config large \
+        --mesh 1,4,2,1 --strategies fsdp,tp --length 64 --prime 8 \
+        --batches 1 --reps 2
 """
 
 from __future__ import annotations
@@ -24,6 +33,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+# this image's jax ignores JAX_PLATFORMS from the environment; honor it
+# (the sharded-decode mode runs on the virtual CPU mesh this way)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,6 +50,11 @@ def main() -> None:
     ap.add_argument("--prime", type=int, default=32)
     ap.add_argument("--batches", type=int, default=(1, 8), nargs="+")
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--mesh", default=None,
+                    help="mesh spec data,fsdp,tensor,seq — decode with "
+                         "params sharded over it (never gathered)")
+    ap.add_argument("--strategies", default="fsdp,tp",
+                    help="sharding strategies when --mesh is given")
     args = ap.parse_args()
 
     from progen_tpu.core.cache import enable_compilation_cache
@@ -52,8 +72,25 @@ def main() -> None:
     policy = make_policy(True)
     model = ProGen(config=cfg, policy=policy)
     toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
-    params = unbox(jax.jit(model.init)(jax.random.key(0), toks))["params"]
-    sampler = make_sampler(cfg, policy)
+    if args.mesh is not None:
+        from progen_tpu.core.mesh import MeshConfig, make_mesh
+        from progen_tpu.parallel.sharding import param_shardings
+
+        strategies = tuple(args.strategies.split(","))
+        mesh = make_mesh(MeshConfig.parse(args.mesh))
+        shardings = param_shardings(model, toks, mesh, strategies)["params"]
+        params = jax.jit(
+            lambda k: unbox(model.init(k, toks))["params"],
+            out_shardings=shardings,
+        )(jax.random.key(0))
+        sampler = make_sampler(cfg, policy, mesh=mesh, strategies=strategies,
+                               params_shardings=shardings)
+        ndev = len(mesh.devices.reshape(-1))
+        print(f"mesh {args.mesh} ({ndev} devices), strategies {strategies}",
+              flush=True)
+    else:
+        params = unbox(jax.jit(model.init)(jax.random.key(0), toks))["params"]
+        sampler = make_sampler(cfg, policy)
 
     rng = np.random.default_rng(0)
     for b in args.batches:

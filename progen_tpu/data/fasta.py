@@ -154,7 +154,11 @@ def _filtered_records(
 ) -> Iterator[tuple[int, str, str]]:
     taken = 0
     for desc, seq in parse_fasta(read_from):
-        if len(seq) > max_seq_len:
+        # empty sequences would collate to an all-zero row, which the eval
+        # step's real-row mask (train/step.py) treats as batch padding and
+        # silently drops — exclude them here so that heuristic stays sound
+        # (every emitted string then contains at least "# " + content)
+        if not seq or len(seq) > max_seq_len:
             continue
         yield taken, desc, seq
         taken += 1

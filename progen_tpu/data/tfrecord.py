@@ -225,7 +225,9 @@ def iterator_from_tfrecords_folder(
             )
         else:
             per_host_skip = skip
-        ds = ds.skip(per_host_skip)
+        if not shuffle_buffer:
+            # unshuffled: skip raw records BEFORE parsing (cheaper)
+            ds = ds.skip(per_host_skip)
         ds = ds.map(
             lambda rec: tf.io.parse_single_example(
                 rec, {"seq": tf.io.FixedLenFeature([], tf.string)}
@@ -238,6 +240,15 @@ def iterator_from_tfrecords_folder(
             # boundaries comes from the sliding buffer itself (intentional);
             # the flag only matters for finite re-iterated datasets.
             ds = ds.shuffle(shuffle_buffer, seed=seed, reshuffle_each_iteration=True)
+            # Deterministic shuffled resume: the seeded shuffle is a pure
+            # function of its input stream, so replaying it from the start
+            # and skipping the already-consumed OUTPUTS continues the
+            # uninterrupted run's record order exactly.  (Skipping before
+            # the shuffle instead would feed the buffer a shifted stream
+            # and re-order records near the cursor.)  Same O(cursor) resume
+            # cost as the raw skip — tf.data decompresses skipped records
+            # either way.
+            ds = ds.skip(per_host_skip)
         # an infinite stream never has a remainder; finite (loop=False)
         # streams keep the reference's trailing short batch
         ds = ds.batch(batch_size, drop_remainder=loop)

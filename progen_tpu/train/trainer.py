@@ -67,8 +67,9 @@ class TrainerConfig:
     mixed_precision: bool = True
     # tf.data sliding-window shuffle over the (pre-shuffled-at-prep) record
     # stream; 0 = off, matching the reference, whose only shuffle happens
-    # at data prep (generate_data.py:119). With a buffer, resume-by-skip
-    # restarts at the right cursor but records near it re-order.
+    # at data prep (generate_data.py:119). Resume-by-skip is deterministic
+    # even when shuffled: the skip applies to the seeded shuffle's OUTPUT
+    # (data/tfrecord.py), replaying the interrupted run's record order.
     shuffle_buffer: int = 0
     # LR schedule (reference is constant-lr; warmup/decay needed >=1.2B)
     lr_schedule: str = "constant"  # "constant" | "cosine" | "linear"

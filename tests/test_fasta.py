@@ -132,6 +132,17 @@ def test_go_prep_and_prime_roundtrip(tmp_path):
     assert decode_tokens(np.asarray(encode_tokens(prime))) == prime
 
 
+def test_empty_sequences_filtered_at_prep(tmp_path):
+    """An empty FASTA record must not reach the tfrecords: it would
+    collate to an all-zero row, indistinguishable from eval batch padding
+    (train/step.py's real-row mask)."""
+    p = tmp_path / "empty.fasta"
+    p.write_text(">P1 ok\nMKLV\n>P2 empty\n>P3 ok\nACDE\n")
+    counts = generate_tfrecords(str(p), str(tmp_path / "rec"),
+                                fraction_valid_data=0.0, seed=0)
+    assert counts == {"train": 2, "valid": 0}
+
+
 def test_unknown_annotation_key_rejected(tmp_path):
     p = tmp_path / "x.fasta"
     p.write_text(">P1 x\nMKLV\n")

@@ -136,7 +136,16 @@ def coordinate(args) -> int:
     # their losses must agree (CP halo exchange + row-sharded SGU vs plain
     # GSPMD).  bf16 matmuls under different reduction orders bound the
     # tolerance.
-    if "loss_after_restore" in existing and "loss_after_restore_sp" in existing:
+    # Guard against pairing losses from different runs: both phases must
+    # have restored the SAME checkpoint directory, and this invocation
+    # must have produced at least one side (merged), so a stale evidence
+    # file can never manufacture a parity verdict on its own.
+    if ("loss_after_restore" in existing
+            and "loss_after_restore_sp" in existing
+            and existing.get("restore_ckpt_phase3")
+            == existing.get("restore_ckpt_sp") is not None
+            and ("loss_after_restore" in merged
+                 or "loss_after_restore_sp" in merged)):
         diff = abs(existing["loss_after_restore"]
                    - existing["loss_after_restore_sp"])
         existing["sp_vs_fsdp_loss_abs_diff"] = diff
@@ -248,6 +257,9 @@ def worker(args) -> int:
 
     cfg = CONFIGS[args.config]
     strategies = ("fsdp", "tp")
+    # per-phase keys (mesh_phase*, restore_ckpt_*) are stamped inside the
+    # phase that actually executed, so a phase-1-only rerun cannot
+    # advertise phases it never ran
     common: dict = {
         "config": args.config,
         "model": cfg.to_dict(),
@@ -255,9 +267,6 @@ def worker(args) -> int:
         "platform": "cpu (8-process jax.distributed, 1 device each)",
         "n_devices": N_PROC,
         "strategies": list(strategies),
-        "mesh_phase1": "data=1,fsdp=4,tensor=2",
-        "mesh_phase3": "data=2,fsdp=2,tensor=2",
-        "mesh_phase_sp": "data=1,fsdp=4,tensor=1,seq=2",
         "remat": "full",
     }
 
@@ -309,6 +318,7 @@ def worker(args) -> int:
 
     # -- phase 1: fsdp=4 x tp=2 ---------------------------------------------
     if args.phase in ("all", "1"):
+        common["mesh_phase1"] = "data=1,fsdp=4,tensor=2"
         mesh, fns = build(MeshConfig(data=1, fsdp=4, tensor=2))
         key = jax.random.key(0)
         abstract = jax.eval_shape(fns.init_state, key)
@@ -396,6 +406,8 @@ def worker(args) -> int:
 
     # -- phase 3: restore onto a DIFFERENT topology, step again -------------
     if args.phase in ("all", "3"):
+        common["mesh_phase3"] = "data=2,fsdp=2,tensor=2"
+        common["restore_ckpt_phase3"] = os.path.abspath(ckpt_dir)
         mesh2, fns2 = build(MeshConfig(data=2, fsdp=2, tensor=2))
         abstract2 = abstract_state_like(fns2)
         if total_param_bytes is None:
@@ -441,6 +453,8 @@ def worker(args) -> int:
     # seq_len.  Loss parity with phase 3 (same checkpoint, same batch) is
     # asserted by the coordinator after the merge.
     if args.phase == "sp":
+        common["mesh_phase_sp"] = "data=1,fsdp=4,tensor=1,seq=2"
+        common["restore_ckpt_sp"] = os.path.abspath(ckpt_dir)
         mesh_sp, fns_sp = build(MeshConfig(data=1, fsdp=4, tensor=1, seq=2),
                                 phase_strategies=("sp", "fsdp"))
         abstract_sp = abstract_state_like(fns_sp)

@@ -60,10 +60,11 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--data_path", default="./train_data")
 @click.option("--shuffle_buffer", default=0,
               help="sliding-window record shuffle (0 = off, reference "
-                   "behavior; data is already shuffled at prep). Resume "
-                   "caveat: the shuffle is applied AFTER the resume skip, "
-                   "so records within ~buffer distance of the resume cursor "
-                   "can repeat or be deferred to the next epoch pass")
+                   "behavior; data is already shuffled at prep). Resume is "
+                   "deterministic: the seeded shuffle replays from the "
+                   "stream start and the cursor skip applies to its OUTPUT, "
+                   "so a resumed run consumes exactly the interrupted run's "
+                   "record order")
 @click.option("--wandb_off", default=False, is_flag=True)
 @click.option("--wandb_project_name", default="progen-training")
 @click.option("--new", default=False, is_flag=True)
