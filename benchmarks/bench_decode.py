@@ -32,13 +32,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from progen_tpu.core.cache import honor_env_platforms
+
+honor_env_platforms()  # the sharded mode runs on the virtual CPU mesh
+
 import jax
-
-# this image's jax ignores JAX_PLATFORMS from the environment; honor it
-# (the sharded-decode mode runs on the virtual CPU mesh this way)
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
 import jax.numpy as jnp
 import numpy as np
 

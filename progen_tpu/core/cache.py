@@ -16,6 +16,19 @@ from __future__ import annotations
 import os
 
 
+def honor_env_platforms() -> None:
+    """Apply ``JAX_PLATFORMS`` from the environment as a config update.
+
+    This image's jax build hardwires its default platform list and
+    ignores the env var; every CLI entrypoint calls this (before any
+    backend initialization) so ``JAX_PLATFORMS=cpu`` behaves as users
+    expect — e.g. driving the virtual 8-device CPU mesh."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def enable_compilation_cache(default_dir: str = "~/.cache/progen_tpu/xla") -> str | None:
     """Turn on JAX's on-disk compilation cache (honoring the env knob).
 

@@ -248,12 +248,11 @@ class Trainer:
         (the CLIs enable it): ``lower().compile()`` populates the on-disk
         cache the later jit call reads, but without that cache the warm
         work could not be reused and would just double compile time."""
-        try:
-            if not jax.config.jax_compilation_cache_dir:
-                return
-        except AttributeError:
-            return
         cfg = self.cfg
+        try:
+            have_disk_cache = bool(jax.config.jax_compilation_cache_dir)
+        except AttributeError:
+            have_disk_cache = False
 
         def abstract(tree):
             return jax.tree.map(
@@ -283,13 +282,46 @@ class Trainer:
                 length=self.model_config.seq_len, top_k=cfg.sample_top_k,
             )),
         ]
-        for name, lower in programs:
-            try:
-                lower().compile()
-            except Exception as e:
-                # warming is an optimization; the loop compiles on demand
-                if jax.process_index() == 0:
-                    print(f"warning: {name} precompile failed ({e!r})")
+        if have_disk_cache:
+            # without the persistent cache, lower().compile() work could
+            # not be reused by the later jit calls and would just double
+            # compile time; the execution warm-up below covers that case
+            for name, lower in programs:
+                try:
+                    lower().compile()
+                except Exception as e:
+                    # warming is an optimization; the loop compiles on
+                    # demand
+                    if jax.process_index() == 0:
+                        print(f"warning: {name} precompile failed ({e!r})")
+
+        # lower().compile() fills the DISK cache, but the loop's jit calls
+        # still pay a fresh trace + cache deserialization the first time
+        # they run — measured ~20s at the first validate_every hook of a
+        # small-config run, a mid-loop stall the throughput window eats.
+        # Execute the two NON-DONATING programs once here so their
+        # in-memory executables exist before the meter starts (train_step
+        # donates its state buffers, so its first-call load stays at step
+        # 1, inside the startup ramp).  Runs with or without the disk
+        # cache; skipped for hooks the run can provably never reach.
+        ms = cfg.max_steps  # None = epochs-bounded: assume hooks fire
+        try:
+            if ms is None or cfg.validate_every <= ms:
+                dummy = self._to_device(np.zeros(
+                    (cfg.batch_size, self.model_config.seq_len + 1),
+                    np.int32))
+                jax.block_until_ready(self.fns.eval_step(state, dummy))
+            if ms is None or cfg.sample_every <= ms:
+                prime_arr, key = self._replicated_prime_and_key(
+                    np.zeros((1, cfg.prime_length), np.int32),
+                    jax.random.key(0))
+                jax.block_until_ready(self.sampler(
+                    {"params": state.params}, key, prime_arr,
+                    length=self.model_config.seq_len, top_k=cfg.sample_top_k,
+                ))
+        except Exception as e:
+            if jax.process_index() == 0:
+                print(f"warning: warm execution failed ({e!r})")
 
     # -- state ---------------------------------------------------------------
 
@@ -619,6 +651,25 @@ class Trainer:
             self._join_checkpoint_thread()
             self.store.wait_until_finished()
 
+    def _replicated_prime_and_key(self, prime_np, key):
+        """Sampler inputs for the global mesh: in multi-process runs both
+        the prime and the rng key must be re-materialized replicated over
+        ALL devices — a host-local array is rejected by jit as an
+        incompatible device set.  (KeySeq is seeded identically on every
+        host, so replicating the key VALUE is sound.)  Single process:
+        plain transfers."""
+        if self.mesh is not None and jax.process_count() > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            prime = jax.make_array_from_process_local_data(
+                repl, np.asarray(prime_np, np.int32))
+            key_data = jax.make_array_from_process_local_data(
+                repl, np.asarray(jax.random.key_data(key)))
+            key = jax.random.wrap_key_data(key_data)
+            return prime, key
+        return jnp.asarray(prime_np), key
+
     def _sample_and_log(self, state, valid_batch, step: int) -> None:
         """In-training sampling (reference train.py:219-228): prime with the
         first ``prime_length`` tokens of a validation row, decode, log.
@@ -630,21 +681,11 @@ class Trainer:
         jit as an incompatible device set)."""
         cfg = self.cfg
         prime_np = np.asarray(valid_batch[:1, : cfg.prime_length], np.int32)
-        key = next(self.keys)
         if self.mesh is not None and jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            from jax.sharding import NamedSharding, PartitionSpec
 
             prime_np = multihost_utils.broadcast_one_to_all(prime_np)
-            repl = NamedSharding(self.mesh, PartitionSpec())
-            prime = jax.make_array_from_process_local_data(repl, prime_np)
-            # KeySeq is seeded identically on every host, so the key VALUE
-            # agrees; re-materialize it replicated over the global mesh.
-            key_data = jax.make_array_from_process_local_data(
-                repl, np.asarray(jax.random.key_data(key)))
-            key = jax.random.wrap_key_data(key_data)
-        else:
-            prime = jnp.asarray(prime_np)
+        prime, key = self._replicated_prime_and_key(prime_np, next(self.keys))
         sampled = self.sampler(
             {"params": state.params}, key, prime,
             length=self.model_config.seq_len, top_k=cfg.sample_top_k,

@@ -4,14 +4,11 @@ model from its stored config, decode with a prime, print.  Decoding runs
 the cached scan sampler instead of O(L) full forwards.
 """
 
-import os
-
 import click
 
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+from progen_tpu.core.cache import honor_env_platforms
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_env_platforms()
 
 
 @click.command()

@@ -86,9 +86,11 @@ def test_sharded_sampler_short_decode(devices8, setup):
 
 def test_large_sharded_sampler_lowers_at_real_shapes(devices8):
     """ProGen-large (1.35B) sharded decode traces + SPMD-lowers at its
-    real dims on the fsdp x tp mesh (shape/sharding validation at the
-    scale where one-chip decode is impossible; execution at these dims
-    is exercised on real hardware, not in CI)."""
+    real dims on the fsdp x tp mesh (shape/sharding validation in CI;
+    EXECUTION at these dims is committed evidence — see
+    benchmarks/decode.md's sharded-decode table, produced by
+    ``bench_decode.py --config large --mesh 1,4,2,1`` on the virtual
+    8-device mesh)."""
     import jax.numpy as jnp
 
     from progen_tpu.models import ProGen

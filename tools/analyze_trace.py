@@ -1,0 +1,95 @@
+"""Summarize a jax.profiler chrome trace: device busy vs idle + top ops.
+
+The xprof/tensorboard profile tooling in this image has incompatible
+protos, so this reads the ``*.trace.json.gz`` the profiler also writes
+(plugins/profile/<run>/), which needs only the json module.  Used to
+attribute the end-to-end-vs-bench MFU gap (benchmarks/configs.md):
+device idle time between step programs is feed/dispatch stall; busy time
+below the bench's step time is a program-content difference.
+
+Usage: ``python tools/analyze_trace.py /path/to/profile_dir``
+"""
+
+from __future__ import annotations
+
+import collections
+import gzip
+import json
+import pathlib
+import sys
+
+
+def find_trace(root: str) -> pathlib.Path:
+    hits = sorted(pathlib.Path(root).rglob("*.trace.json.gz"))
+    if not hits:
+        sys.exit(f"no *.trace.json.gz under {root}")
+    return hits[-1]
+
+
+def main() -> None:
+    path = find_trace(sys.argv[1] if len(sys.argv) > 1 else ".")
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+
+    # map pid -> process name (device lanes are "/device:TPU:0" or "TPU:0")
+    pid_names: dict[int, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+
+    device_pids = {p for p, n in pid_names.items()
+                   if "TPU" in n.upper() or "device:" in n}
+    # complete events on device lanes = executed programs/ops
+    dev = [e for e in events
+           if e.get("ph") == "X" and e.get("pid") in device_pids
+           and e.get("dur", 0) > 0]
+    if not dev:
+        sys.exit(f"no device events in {path} (lanes: {sorted(pid_names.values())})")
+
+    # per-lane busy/span; lanes can overlap (one per core/stream)
+    by_lane: dict[tuple, list] = collections.defaultdict(list)
+    for e in dev:
+        by_lane[(e["pid"], e.get("tid"))].append(e)
+    print(f"trace: {path}")
+    total_top = collections.Counter()
+    for lane, evs in sorted(by_lane.items(), key=lambda kv: -len(kv[1])):
+        evs.sort(key=lambda e: e["ts"])
+        span = evs[-1]["ts"] + evs[-1]["dur"] - evs[0]["ts"]
+        # merge overlapping intervals for true busy time
+        busy, cur_s, cur_e = 0.0, None, None
+        for e in evs:
+            s, t = e["ts"], e["ts"] + e["dur"]
+            if cur_e is None or s > cur_e:
+                if cur_e is not None:
+                    busy += cur_e - cur_s
+                cur_s, cur_e = s, t
+            else:
+                cur_e = max(cur_e, t)
+        busy += (cur_e - cur_s) if cur_e is not None else 0.0
+        name = pid_names.get(lane[0], lane[0])
+        print(f"lane {name} tid={lane[1]}: {len(evs)} events, "
+              f"span {span/1e6:.3f}s, busy {busy/1e6:.3f}s "
+              f"({100*busy/span:.1f}%), idle {(span-busy)/1e6:.3f}s")
+        for e in evs:
+            total_top[e["name"]] += e["dur"]
+    print("\ntop device programs by total time:")
+    for name, dur in total_top.most_common(10):
+        print(f"  {dur/1e6:9.3f}s  {name[:100]}")
+
+    # biggest inter-event gaps on the busiest lane = stalls to attribute
+    lane, evs = max(by_lane.items(), key=lambda kv: len(kv[1]))
+    evs.sort(key=lambda e: e["ts"])
+    gaps = []
+    for a, b in zip(evs, evs[1:]):
+        g = b["ts"] - (a["ts"] + a["dur"])
+        if g > 0:
+            gaps.append((g, a["name"][:60], b["name"][:60]))
+    gaps.sort(reverse=True)
+    print(f"\nbiggest gaps on lane {pid_names.get(lane[0], lane[0])}:")
+    for g, a, b in gaps[:10]:
+        print(f"  {g/1e3:8.2f}ms between [{a}] and [{b}]")
+
+
+if __name__ == "__main__":
+    main()

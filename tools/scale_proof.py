@@ -137,19 +137,32 @@ def coordinate(args) -> int:
     # GSPMD).  bf16 matmuls under different reduction orders bound the
     # tolerance.
     # Guard against pairing losses from different runs: both phases must
-    # have restored the SAME checkpoint directory, and this invocation
-    # must have produced at least one side (merged), so a stale evidence
-    # file can never manufacture a parity verdict on its own.
+    # have restored the SAME checkpoint directory with the SAME content
+    # (mtime taken at restore — a phase-1 rerun into the same path
+    # rewrites the step dir and bumps it), and this invocation must have
+    # produced at least one side (merged), so a stale evidence file can
+    # never manufacture a parity verdict on its own.  When the guard
+    # declines, any previously written verdict is dropped rather than
+    # left beside losses it no longer describes.
+    same_ckpt = (
+        existing.get("restore_ckpt_phase3")
+        == existing.get("restore_ckpt_sp") is not None
+        and existing.get("restore_ckpt_mtime_phase3")
+        == existing.get("restore_ckpt_mtime_sp") is not None
+    )
     if ("loss_after_restore" in existing
             and "loss_after_restore_sp" in existing
-            and existing.get("restore_ckpt_phase3")
-            == existing.get("restore_ckpt_sp") is not None
+            and same_ckpt
             and ("loss_after_restore" in merged
                  or "loss_after_restore_sp" in merged)):
         diff = abs(existing["loss_after_restore"]
                    - existing["loss_after_restore_sp"])
         existing["sp_vs_fsdp_loss_abs_diff"] = diff
         existing["sp_loss_parity_ok"] = bool(diff < 5e-3)
+    elif ("loss_after_restore" in merged
+          or "loss_after_restore_sp" in merged) and not same_ckpt:
+        existing.pop("sp_vs_fsdp_loss_abs_diff", None)
+        existing.pop("sp_loss_parity_ok", None)
     with open(out_path, "w") as fh:
         json.dump(existing, fh, indent=1)
     print(f"[scale_proof] wrote {out_path}")
@@ -408,6 +421,7 @@ def worker(args) -> int:
     if args.phase in ("all", "3"):
         common["mesh_phase3"] = "data=2,fsdp=2,tensor=2"
         common["restore_ckpt_phase3"] = os.path.abspath(ckpt_dir)
+        common["restore_ckpt_mtime_phase3"] = os.path.getmtime(ckpt_dir)
         mesh2, fns2 = build(MeshConfig(data=2, fsdp=2, tensor=2))
         abstract2 = abstract_state_like(fns2)
         if total_param_bytes is None:
@@ -455,6 +469,7 @@ def worker(args) -> int:
     if args.phase == "sp":
         common["mesh_phase_sp"] = "data=1,fsdp=4,tensor=1,seq=2"
         common["restore_ckpt_sp"] = os.path.abspath(ckpt_dir)
+        common["restore_ckpt_mtime_sp"] = os.path.getmtime(ckpt_dir)
         mesh_sp, fns_sp = build(MeshConfig(data=1, fsdp=4, tensor=1, seq=2),
                                 phase_strategies=("sp", "fsdp"))
         abstract_sp = abstract_state_like(fns_sp)

@@ -12,13 +12,11 @@ from pathlib import Path
 
 import click
 
-# this image's jax build hardwires its default platform list and ignores
-# JAX_PLATFORMS from the environment; honor it explicitly so CPU runs and
-# tests behave as users expect
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+# this image's jax build ignores JAX_PLATFORMS from the environment;
+# honor it explicitly so CPU runs and tests behave as users expect
+from progen_tpu.core.cache import honor_env_platforms
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_env_platforms()
 
 # keep stdlib tomllib (py3.11+); the reference used the third-party `toml`
 import tomllib
