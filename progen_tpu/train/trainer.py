@@ -305,13 +305,19 @@ class Trainer:
         # 1, inside the startup ramp).  Runs with or without the disk
         # cache; skipped for hooks the run can provably never reach.
         ms = cfg.max_steps  # None = epochs-bounded: assume hooks fire
-        try:
-            if ms is None or cfg.validate_every <= ms:
+        # separate try blocks: a failed eval warm-up must not skip the
+        # sampler warm-up (whose mid-loop stall is the larger one)
+        if ms is None or cfg.validate_every <= ms:
+            try:
                 dummy = self._to_device(np.zeros(
                     (cfg.batch_size, self.model_config.seq_len + 1),
                     np.int32))
                 jax.block_until_ready(self.fns.eval_step(state, dummy))
-            if ms is None or cfg.sample_every <= ms:
+            except Exception as e:
+                if jax.process_index() == 0:
+                    print(f"warning: eval warm execution failed ({e!r})")
+        if ms is None or cfg.sample_every <= ms:
+            try:
                 prime_arr, key = self._replicated_prime_and_key(
                     np.zeros((1, cfg.prime_length), np.int32),
                     jax.random.key(0))
@@ -319,9 +325,9 @@ class Trainer:
                     {"params": state.params}, key, prime_arr,
                     length=self.model_config.seq_len, top_k=cfg.sample_top_k,
                 ))
-        except Exception as e:
-            if jax.process_index() == 0:
-                print(f"warning: warm execution failed ({e!r})")
+            except Exception as e:
+                if jax.process_index() == 0:
+                    print(f"warning: sampler warm execution failed ({e!r})")
 
     # -- state ---------------------------------------------------------------
 

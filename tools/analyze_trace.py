@@ -48,47 +48,54 @@ def main() -> None:
         sys.exit(f"no device events in {path} (lanes: {sorted(pid_names.values())})")
 
     # per-lane busy/span; lanes can overlap (one per core/stream)
+    def merged_intervals(evs):
+        """Coalesce possibly-nested/overlapping events into disjoint busy
+        intervals — chrome traces nest ops inside their enclosing program
+        event, so both span and gaps must be computed on the MERGED
+        intervals (raw event arithmetic yields busy > span and phantom
+        'stalls' between child ops of a still-running program)."""
+        out = []
+        for e in sorted(evs, key=lambda e: e["ts"]):
+            s, t = e["ts"], e["ts"] + e["dur"]
+            if out and s <= out[-1][1]:
+                out[-1][1] = max(out[-1][1], t)
+            else:
+                out.append([s, t])
+        return out
+
     by_lane: dict[tuple, list] = collections.defaultdict(list)
     for e in dev:
         by_lane[(e["pid"], e.get("tid"))].append(e)
     print(f"trace: {path}")
     total_top = collections.Counter()
     for lane, evs in sorted(by_lane.items(), key=lambda kv: -len(kv[1])):
-        evs.sort(key=lambda e: e["ts"])
-        span = evs[-1]["ts"] + evs[-1]["dur"] - evs[0]["ts"]
-        # merge overlapping intervals for true busy time
-        busy, cur_s, cur_e = 0.0, None, None
-        for e in evs:
-            s, t = e["ts"], e["ts"] + e["dur"]
-            if cur_e is None or s > cur_e:
-                if cur_e is not None:
-                    busy += cur_e - cur_s
-                cur_s, cur_e = s, t
-            else:
-                cur_e = max(cur_e, t)
-        busy += (cur_e - cur_s) if cur_e is not None else 0.0
+        ivals = merged_intervals(evs)
+        span = ivals[-1][1] - ivals[0][0]
+        busy = sum(t - s for s, t in ivals)
         name = pid_names.get(lane[0], lane[0])
         print(f"lane {name} tid={lane[1]}: {len(evs)} events, "
               f"span {span/1e6:.3f}s, busy {busy/1e6:.3f}s "
               f"({100*busy/span:.1f}%), idle {(span-busy)/1e6:.3f}s")
         for e in evs:
             total_top[e["name"]] += e["dur"]
-    print("\ntop device programs by total time:")
+    print("\ntop device programs by total time (nested events double-count "
+          "toward their parents):")
     for name, dur in total_top.most_common(10):
         print(f"  {dur/1e6:9.3f}s  {name[:100]}")
 
-    # biggest inter-event gaps on the busiest lane = stalls to attribute
+    # biggest TRUE idle gaps (between merged busy intervals) on the
+    # busiest lane = the stalls to attribute to feed/dispatch
     lane, evs = max(by_lane.items(), key=lambda kv: len(kv[1]))
-    evs.sort(key=lambda e: e["ts"])
-    gaps = []
-    for a, b in zip(evs, evs[1:]):
-        g = b["ts"] - (a["ts"] + a["dur"])
-        if g > 0:
-            gaps.append((g, a["name"][:60], b["name"][:60]))
-    gaps.sort(reverse=True)
-    print(f"\nbiggest gaps on lane {pid_names.get(lane[0], lane[0])}:")
-    for g, a, b in gaps[:10]:
-        print(f"  {g/1e3:8.2f}ms between [{a}] and [{b}]")
+    ivals = merged_intervals(evs)
+    gaps = sorted(
+        ((b[0] - a[1], a[1]) for a, b in zip(ivals, ivals[1:])
+         if b[0] > a[1]),
+        reverse=True,
+    )
+    print(f"\nbiggest idle gaps on lane {pid_names.get(lane[0], lane[0])}:")
+    t0 = ivals[0][0]
+    for g, at in gaps[:10]:
+        print(f"  {g/1e3:8.2f}ms at t+{(at - t0)/1e6:.3f}s")
 
 
 if __name__ == "__main__":

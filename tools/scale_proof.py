@@ -98,7 +98,8 @@ def coordinate(args) -> int:
              "--worker", str(pid), "--workdir", workdir,
              "--port", str(port)]
             + (["--ckpt", args.ckpt] if args.ckpt else [])
-            + (["--skip-save"] if args.skip_save else []),
+            + (["--skip-save"] if args.skip_save else [])
+            + ["--mesh1", args.mesh1],
             env=env, cwd=REPO,
         )
         for pid in range(N_PROC)
@@ -112,7 +113,7 @@ def coordinate(args) -> int:
     merged: dict = {}
     byte_tables: dict[str, dict] = {}
     for pid in range(N_PROC):
-        for tag in ("p1", "p3", "psp"):
+        for tag in ("p1init", "p1", "p3", "psp"):
             frag = os.path.join(workdir, f"fragment_{tag}_{pid}.json")
             if not os.path.exists(frag):
                 continue
@@ -329,10 +330,18 @@ def worker(args) -> int:
     ckpt_dir = args.ckpt or os.path.join(workdir, "ckpt")
     store = CheckpointStore(ckpt_dir, keep_last_n=1)
 
-    # -- phase 1: fsdp=4 x tp=2 ---------------------------------------------
+    # -- phase 1: fsdp=4 x tp=2 (or --mesh1; XL at batch 1 needs a layout
+    # whose batch divisor data*fsdp is 1, i.e. pure tensor parallelism) ----
     if args.phase in ("all", "1"):
-        common["mesh_phase1"] = "data=1,fsdp=4,tensor=2"
-        mesh, fns = build(MeshConfig(data=1, fsdp=4, tensor=2))
+        mesh1_cfg = MeshConfig.parse(args.mesh1)
+        sizes = mesh1_cfg.resolve(N_PROC)
+        # labeled form matching the other mesh_* keys (seq omitted at 1,
+        # as in the committed evidence files)
+        names = ("data", "fsdp", "tensor", "seq")
+        upto = 4 if sizes[3] > 1 else 3
+        common["mesh_phase1"] = ",".join(
+            f"{n}={s}" for n, s in zip(names[:upto], sizes[:upto]))
+        mesh, fns = build(mesh1_cfg)
         key = jax.random.key(0)
         abstract = jax.eval_shape(fns.init_state, key)
         common["compile_init_seconds"] = round(_stagger(
@@ -360,6 +369,13 @@ def worker(args) -> int:
         # the SGU spatial weights (fsdp-sharded only, i.e. 4-way not 8) and
         # get a loose bound — at base scale those are <1% of params.
         total_param_bytes = 4 * num_params
+        # evidence checkpoint BEFORE the audit assert and the (possibly
+        # hours-long) step: the byte table is proof — or diagnosis —
+        # even if the audit trips or a deadline cuts the step off
+        flush_fragment("p1init", {
+            "per_device_param_bytes": param_bytes,
+            "per_device_opt_state_bytes": opt_bytes,
+        })
         assert max(param_bytes.values()) < total_param_bytes / N_PROC * tol, (
             f"param sharding uneven on {pid}: {param_bytes} vs "
             f"{total_param_bytes}/{N_PROC}"
@@ -536,6 +552,10 @@ def main() -> int:
     parser.add_argument("--skip-save", action="store_true",
                         help="phase 1 without the cooperative save (XL's "
                              "state exceeds this box's disk)")
+    parser.add_argument("--mesh1", default="1,4,2,1",
+                        help="phase-1 mesh spec data,fsdp,tensor,seq; "
+                             "batch must divide data*fsdp (XL at batch 1 "
+                             "-> 1,1,8,1, pure tensor parallelism)")
     parser.add_argument("--worker", type=int, default=None)
     parser.add_argument("--workdir", default=None)
     parser.add_argument("--port", type=int, default=12123)
