@@ -113,7 +113,7 @@ def coordinate(args) -> int:
     merged: dict = {}
     byte_tables: dict[str, dict] = {}
     for pid in range(N_PROC):
-        for tag in ("p1init", "p1", "p3", "psp"):
+        for tag in ("p1init", "p1", "p3", "psp_restore", "psp"):
             frag = os.path.join(workdir, f"fragment_{tag}_{pid}.json")
             if not os.path.exists(frag):
                 continue
@@ -145,6 +145,15 @@ def coordinate(args) -> int:
     # never manufacture a parity verdict on its own.  When the guard
     # declines, any previously written verdict is dropped rather than
     # left beside losses it no longer describes.
+    # a restore-only fragment (run killed before its step) carries a fresh
+    # mtime but no loss; the stale loss it displaces must go with it, or a
+    # later run could pair losses from different checkpoint contents
+    for mt_key, loss_key in (("restore_ckpt_mtime_sp", "loss_after_restore_sp"),
+                             ("restore_ckpt_mtime_phase3", "loss_after_restore")):
+        if mt_key in merged and loss_key not in merged:
+            existing.pop(loss_key, None)
+            existing.pop("sp_vs_fsdp_loss_abs_diff", None)
+            existing.pop("sp_loss_parity_ok", None)
     same_ckpt = (
         existing.get("restore_ckpt_phase3")
         == existing.get("restore_ckpt_sp") is not None
@@ -507,6 +516,14 @@ def worker(args) -> int:
         assert int(restored.step) == store.latest_step()
 
         param_bytes_sp = _local_bytes(restored.params)
+        # evidence checkpoint: the seq-mesh restore + byte audit are proof
+        # on their own if a deadline cuts the (85-90 min on this box) step
+        # off; on success the psp fragment adds the loss/timing keys
+        log(f"seq-mesh restore done ({common['restore_seconds_sp']}s); "
+            "stepping")
+        flush_fragment("psp_restore", {
+            "per_device_param_bytes_sp_mesh": param_bytes_sp,
+        })
         # params shard over fsdp=4 only (replicated across seq) -> ~1/4 each
         assert max(param_bytes_sp.values()) < total_param_bytes / 4 * tol, (
             f"param sharding uneven on {pid} (sp mesh): {param_bytes_sp}"
@@ -521,9 +538,7 @@ def worker(args) -> int:
         assert np.isfinite(loss_sp)
         log(f"seq-mesh (fsdp=4,seq=2) restored step ok: loss={loss_sp:.4f}")
 
-        flush_fragment("psp", {
-            "per_device_param_bytes_sp_mesh": param_bytes_sp,
-        })
+        flush_fragment("psp", {})  # byte table already in psp_restore
 
     store.close()
     return 0
