@@ -31,12 +31,21 @@ import jax.numpy as jnp
 import orbax.checkpoint as ocp
 from etils import epath
 
+from progen_tpu.resilience import faults
+from progen_tpu.resilience.retry import RetryPolicy, retry_call
+
 
 class CheckpointStore:
-    def __init__(self, path: str, keep_last_n: int | None = 500):
+    def __init__(self, path: str, keep_last_n: int | None = 500,
+                 retry_policy: RetryPolicy | None = None):
         self._path = epath.Path(path)
         self._keep_last_n = keep_last_n
         self._mgr: ocp.CheckpointManager | None = None
+        # every storage-touching operation goes through this policy: GCS
+        # 503s/429s and tunnel drops are routine at pod scale, and one
+        # failed periodic save must not kill a run that has a perfectly
+        # good retry budget (env-tunable: PROGEN_CKPT_RETRY_*)
+        self._retry = retry_policy or RetryPolicy.from_env("PROGEN_CKPT_RETRY")
 
     # lazily (re)create so reset() can drop the directory out from under us
     def _manager(self) -> ocp.CheckpointManager:
@@ -62,7 +71,12 @@ class CheckpointStore:
 
     def latest_step(self) -> int | None:
         """Newest saved step, INCLUDING an async save still in flight."""
-        return self._manager().latest_step()
+
+        def _steps():
+            faults.inject("ckpt.steps")
+            return self._manager().latest_step()
+
+        return retry_call(_steps, policy=self._retry, label="ckpt.steps")
 
     def reached_preemption(self, step: int) -> bool:
         """Cross-host-consistent preemption check (orbax rides the JAX
@@ -110,29 +124,54 @@ class CheckpointStore:
         in the background; readers and :meth:`close` wait for it.
         """
         mgr = self._manager()
-        # membership, not latest_step(): re-converting a reference pickle
-        # into a store that has trained past step 0 collides with a step
-        # that exists but is no longer the newest
-        if step in mgr.all_steps():
-            if not overwrite:
-                return False
-            mgr.wait_until_finished()
-            mgr.delete(step)
         meta = {
             "next_seq_index": int(next_seq_index),
             "model_config": model_config,
             "run_id": run_id,
             "train_step": int(state.step),
         }
-        mgr.save(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardSave(state.params),
-                opt_state=ocp.args.StandardSave(state.opt_state),
-                meta=ocp.args.JsonSave(meta),
-            ),
-        )
-        return True
+
+        # the whole issue-save is one retried unit: orbax commits are
+        # atomic (tmp dir + rename), so a failed attempt leaves no step
+        # registered and the next attempt re-runs the membership check
+        # against unchanged truth
+        def _issue() -> bool:
+            faults.inject("ckpt.save")
+            # a still-finalizing previous async save makes orbax reject a
+            # new one (AssertionError on its finalize thread); saves are
+            # issued off the training critical path, so waiting here is
+            # free and removes the race.  orbax only CLEARS the finalize
+            # handle when the wait comes from the thread that issued that
+            # save — the trainer issues each background save from a fresh
+            # thread, so drop the joined-but-stale handle ourselves
+            # (guarded: only when its thread is provably finished).
+            mgr.wait_until_finished()
+            stale = getattr(mgr, "_finalize_thread", None)
+            if stale is not None and not stale.is_alive():
+                lock = getattr(mgr, "_finalize_thread_lock", None)
+                if lock is not None:
+                    with lock:
+                        if mgr._finalize_thread is stale:
+                            mgr._finalize_thread = None
+            # membership, not latest_step(): re-converting a reference
+            # pickle into a store that has trained past step 0 collides
+            # with a step that exists but is no longer the newest
+            if step in mgr.all_steps():
+                if not overwrite:
+                    return False
+                mgr.delete(step)
+            mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardSave(state.params),
+                    opt_state=ocp.args.StandardSave(state.opt_state),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+            )
+            return True
+
+        return retry_call(_issue, policy=self._retry,
+                          label=f"ckpt.save[{step}]")
 
     def wait_until_finished(self) -> None:
         """Block until any in-flight async save has committed to storage."""
@@ -147,7 +186,14 @@ class CheckpointStore:
         step = step if step is not None else mgr.latest_step()
         if step is None:
             return None
-        out = mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+
+        def _restore():
+            faults.inject("ckpt.restore")
+            return mgr.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+
+        out = retry_call(_restore, policy=self._retry,
+                         label=f"ckpt.restore_meta[{step}]")
         return dict(out["meta"])
 
     def restore_params(self, abstract_params: Any, step: int | None = None):
@@ -162,10 +208,17 @@ class CheckpointStore:
         step = step if step is not None else mgr.latest_step()
         if step is None:
             return None
-        out = mgr.restore(
-            step,
-            args=ocp.args.Composite(params=ocp.args.StandardRestore(abstract_params)),
-        )
+
+        def _restore():
+            faults.inject("ckpt.restore")
+            return mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore(abstract_params)),
+            )
+
+        out = retry_call(_restore, policy=self._retry,
+                         label=f"ckpt.restore_params[{step}]")
         return out["params"]
 
     def restore_state(self, abstract_state: Any, step: int | None = None):
@@ -179,14 +232,21 @@ class CheckpointStore:
         step = step if step is not None else mgr.latest_step()
         if step is None:
             return None
-        out = mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(abstract_state.params),
-                opt_state=ocp.args.StandardRestore(abstract_state.opt_state),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+
+        def _restore():
+            faults.inject("ckpt.restore")
+            return mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore(abstract_state.params),
+                    opt_state=ocp.args.StandardRestore(
+                        abstract_state.opt_state),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
+
+        out = retry_call(_restore, policy=self._retry,
+                         label=f"ckpt.restore_state[{step}]")
         return type(abstract_state)(
             step=jnp.asarray(out["meta"]["train_step"], jnp.int32),
             params=out["params"],

@@ -114,7 +114,16 @@ def initialize_distributed(coordinator_address: str | None = None,
     On TPU pods with default env vars, ``jax.distributed.initialize()`` with
     no arguments autodetects everything.  Safe to call exactly once per
     process before any other JAX call.
+
+    Connection attempts are retried with backoff: at pod bring-up the
+    coordinator routinely comes up seconds after the workers (its
+    UNAVAILABLE/DEADLINE_EXCEEDED gRPC errors classify as transient;
+    "already initialized" is fatal and propagates immediately).
+    Env-tunable via ``PROGEN_DIST_RETRY_*``.
     """
+    from progen_tpu.resilience import faults
+    from progen_tpu.resilience.retry import RetryPolicy, retry_call
+
     kwargs = {}
     if coordinator_address is not None:
         kwargs.update(
@@ -122,4 +131,14 @@ def initialize_distributed(coordinator_address: str | None = None,
             num_processes=num_processes,
             process_id=process_id,
         )
-    jax.distributed.initialize(**kwargs)
+
+    def _init() -> None:
+        faults.inject("dist.init")
+        jax.distributed.initialize(**kwargs)
+
+    retry_call(
+        _init,
+        policy=RetryPolicy.from_env("PROGEN_DIST_RETRY", base_delay=1.0,
+                                    max_attempts=5, deadline=300.0),
+        label="jax.distributed.initialize",
+    )

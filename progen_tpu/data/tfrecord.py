@@ -36,6 +36,16 @@ from typing import Iterator
 import numpy as np
 
 from progen_tpu.data.tokenizer import OFFSET
+from progen_tpu.resilience import faults
+from progen_tpu.resilience.retry import RetryPolicy, retry_call
+
+
+@functools.lru_cache(maxsize=1)
+def _retry_policy() -> RetryPolicy:
+    """Stream-open retry: a GCS glob or the first record fetch hitting a
+    503 must not kill a run (env-tunable: PROGEN_DATA_RETRY_*)."""
+    return RetryPolicy.from_env("PROGEN_DATA_RETRY", base_delay=0.5,
+                                deadline=60.0)
 
 
 @functools.lru_cache(maxsize=1)
@@ -156,12 +166,16 @@ def list_shards(folder: str, data_type: str = "train") -> list[str]:
     """Shard files for a split, local or ``gs://`` (sorted for determinism;
     the reference relies on glob order, which is unstable — sorting is a
     conscious fix)."""
-    if folder.startswith("gs://"):
-        tf = _tf()
-        names = tf.io.gfile.glob(f"{folder}/*.{data_type}.tfrecord.gz")
-    else:
-        names = [str(p) for p in Path(folder).glob(f"**/*.{data_type}.tfrecord.gz")]
-    return sorted(names)
+    def _glob() -> list[str]:
+        faults.inject("data.glob")
+        if folder.startswith("gs://"):
+            tf = _tf()
+            return tf.io.gfile.glob(f"{folder}/*.{data_type}.tfrecord.gz")
+        return [str(p)
+                for p in Path(folder).glob(f"**/*.{data_type}.tfrecord.gz")]
+
+    return sorted(retry_call(_glob, policy=_retry_policy(),
+                             label="data.glob"))
 
 
 def count_sequences(folder: str, data_type: str = "train") -> int:
@@ -253,7 +267,28 @@ def iterator_from_tfrecords_folder(
         # streams keep the reference's trailing short batch
         ds = ds.batch(batch_size, drop_remainder=loop)
         ds = ds.prefetch(tf.data.AUTOTUNE)
-        for raw in ds.as_numpy_iterator():
+
+        # tf.data opens the shard files lazily at the FIRST next(); retry
+        # the open+first-fetch as one unit (a fresh numpy iterator per
+        # attempt — no records have been consumed yet, so re-opening is
+        # exact).  Mid-stream failures are NOT retried here: the stream
+        # position would be lost, and the trainer's resume loop
+        # (re-restore + cursor skip) is the correct recovery at that
+        # level.
+        def _open():
+            faults.inject("data.open")
+            np_it = ds.as_numpy_iterator()
+            try:
+                return np_it, next(np_it)
+            except StopIteration:
+                return np_it, None
+
+        np_it, first = retry_call(_open, policy=_retry_policy(),
+                                  label="data.open")
+        if first is None:
+            return
+        yield collate(list(first), seq_len)
+        for raw in np_it:
             yield collate(list(raw), seq_len)
 
     return num_seqs, iter_fn

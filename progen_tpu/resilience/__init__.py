@@ -1,0 +1,36 @@
+"""Resilience layer: retry/backoff, fault injection, stall watchdog.
+
+At pod scale preemptions, flaky storage and stuck collectives are the
+steady state; this package is the one place the stack's answers to them
+live.  See ``docs/RESILIENCE.md`` for the operator view.
+"""
+
+from progen_tpu.resilience import faults
+from progen_tpu.resilience.retry import (
+    AttemptTimeout,
+    RetryError,
+    RetryPolicy,
+    default_classifier,
+    retriable,
+    retry_call,
+)
+from progen_tpu.resilience.watchdog import (
+    WATCHDOG_EXIT_CODE,
+    FlightRecorder,
+    Watchdog,
+    dump_all_stacks,
+)
+
+__all__ = [
+    "AttemptTimeout",
+    "FlightRecorder",
+    "RetryError",
+    "RetryPolicy",
+    "WATCHDOG_EXIT_CODE",
+    "Watchdog",
+    "default_classifier",
+    "dump_all_stacks",
+    "faults",
+    "retriable",
+    "retry_call",
+]

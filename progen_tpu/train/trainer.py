@@ -18,6 +18,7 @@ for TPU:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Sequence
 
@@ -42,6 +43,9 @@ from progen_tpu.observe import (
     peak_flops_per_chip,
     profile_trace,
 )
+from progen_tpu.resilience import faults
+from progen_tpu.resilience.retry import RetryError, default_classifier
+from progen_tpu.resilience.watchdog import FlightRecorder, Watchdog
 from progen_tpu.train.memory import check_fits, device_hbm_bytes
 from progen_tpu.train.memory import plan as memory_plan
 from progen_tpu.train.optimizer import make_optimizer
@@ -97,6 +101,26 @@ class TrainerConfig:
     sample_top_k: int = 25         # reference hardcodes 25 (train.py:224)
     profile_dir: str | None = None
     max_steps: int | None = None   # optional hard stop (tests/benches)
+    # -- resilience ---------------------------------------------------------
+    # pre-loop sampler warm execution (minutes of decode compile on real
+    # configs): off, a cold compile stalls the loop at the first
+    # sample_every hook instead; independent of the flag, the warm-up is
+    # skipped whenever no sample hook can fire in this run (e.g. a
+    # preemption restart close to max_steps)
+    warm_sampler: bool = True
+    # total tries of the train loop: on a TRANSIENT failure (I/O retry
+    # exhaustion, dropped tunnel...) the trainer re-restores from the
+    # latest checkpoint and continues, up to run_attempts-1 times; fatal
+    # errors always propagate immediately.  1 = fail fast (library
+    # default; the train.py CLI defaults to 3).
+    run_attempts: int = 1
+    # seconds without a completed step before the watchdog dumps all
+    # thread stacks + the flight-recorder ring to watchdog_dir and exits
+    # nonzero (None = off).  Size it to several worst-case step times —
+    # a hung collective never returns, a slow step does.
+    watchdog_timeout: float | None = None
+    watchdog_dir: str | None = None   # default: the tracker's run dir
+    flight_recorder_n: int = 64       # last-N-events ring
 
 
 class Trainer:
@@ -214,6 +238,11 @@ class Trainer:
         # would desync the cooperative save).
         self._preempt_requested = False
         self._ckpt_thread = None
+        # flight recorder always on (O(1) dict appends); the watchdog
+        # only when configured.  The recorder outlives run() attempts so
+        # a post-retry dump still shows the pre-failure history.
+        self._recorder = FlightRecorder(cfg.flight_recorder_n)
+        self._watchdog: Watchdog | None = None
         if jax.process_count() == 1:
             import signal
 
@@ -239,7 +268,7 @@ class Trainer:
             )
         return jnp.asarray(np_batch)
 
-    def _warm_compiles(self, state) -> None:
+    def _warm_compiles(self, state, global_step: int = 0) -> None:
         """AOT-compile every jitted program the loop will call, BEFORE the
         throughput meter starts — the decode scan alone is minutes of
         compile cold, and paying it mid-loop stalls training (measured: a
@@ -273,15 +302,47 @@ class Trainer:
             jnp.int32,
             sharding=self.data_sharding,
         )
-        prime = jax.ShapeDtypeStruct((1, cfg.prime_length), jnp.int32)
+        # the real sampler call feeds prime/key REPLICATED over the global
+        # mesh (_replicated_prime_and_key); the warm program must carry the
+        # same shardings or the multi-host compile-cache entry never
+        # matches the mid-loop call and step-1 still compiles cold
+        repl = None
+        if self.mesh is not None and jax.process_count() > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+        prime = jax.ShapeDtypeStruct((1, cfg.prime_length), jnp.int32,
+                                     sharding=repl)
+        key0 = jax.random.key(0)
+        key_abstract = jax.ShapeDtypeStruct(key0.shape, key0.dtype,
+                                            sharding=repl)
+
+        # a hook that cannot fire between here and the end of the run
+        # (resume near max_steps, or a cadence past the horizon) buys
+        # nothing from warming — notably the sampler's minutes-long decode
+        # compile on a preemption restart
+        ms = cfg.max_steps  # None = epochs-bounded: assume hooks fire
+
+        def hook_due(every: int) -> bool:
+            next_hook = (global_step // every + 1) * every
+            return ms is None or next_hook <= ms
+
+        validate_due = hook_due(cfg.validate_every)
+        sample_due = cfg.warm_sampler and hook_due(cfg.sample_every)
+
         programs = [
             ("train_step", lambda: self.fns.train_step.lower(st, batch)),
-            ("eval_step", lambda: self.fns.eval_step.lower(st, batch)),
-            ("sampler", lambda: self.sampler.lower(
-                {"params": st.params}, jax.random.key(0), prime,
-                length=self.model_config.seq_len, top_k=cfg.sample_top_k,
-            )),
         ]
+        if validate_due:
+            programs.append(
+                ("eval_step", lambda: self.fns.eval_step.lower(st, batch)))
+        if sample_due:
+            programs.append(
+                ("sampler", lambda: self.sampler.lower(
+                    {"params": st.params}, key_abstract, prime,
+                    length=self.model_config.seq_len,
+                    top_k=cfg.sample_top_k,
+                )))
         if have_disk_cache:
             # without the persistent cache, lower().compile() work could
             # not be reused by the later jit calls and would just double
@@ -304,10 +365,9 @@ class Trainer:
         # donates its state buffers, so its first-call load stays at step
         # 1, inside the startup ramp).  Runs with or without the disk
         # cache; skipped for hooks the run can provably never reach.
-        ms = cfg.max_steps  # None = epochs-bounded: assume hooks fire
         # separate try blocks: a failed eval warm-up must not skip the
         # sampler warm-up (whose mid-loop stall is the larger one)
-        if ms is None or cfg.validate_every <= ms:
+        if validate_due:
             try:
                 dummy = self._to_device(np.zeros(
                     (cfg.batch_size, self.model_config.seq_len + 1),
@@ -316,7 +376,7 @@ class Trainer:
             except Exception as e:
                 if jax.process_index() == 0:
                     print(f"warning: eval warm execution failed ({e!r})")
-        if ms is None or cfg.sample_every <= ms:
+        if sample_due:
             try:
                 prime_arr, key = self._replicated_prime_and_key(
                     np.zeros((1, cfg.prime_length), np.int32),
@@ -352,6 +412,40 @@ class Trainer:
     # -- loop ----------------------------------------------------------------
 
     def run(self) -> dict[str, Any]:
+        """Crash-safe driver: up to ``cfg.run_attempts`` tries of the train
+        loop.  A TRANSIENT failure (I/O retry exhaustion, dropped tunnel,
+        injected fault) re-restores from the latest checkpoint — at worst
+        replaying the steps since the last save — and continues; fatal
+        errors (and exhaustion of the attempt budget) propagate."""
+        attempts = max(1, self.cfg.run_attempts)
+        for attempt in range(1, attempts + 1):
+            try:
+                return self._run_attempt()
+            except Exception as e:
+                # RetryError means the I/O layer already burned its finer-
+                # grained budget on something transient; the coarse answer
+                # is a re-restore, not a crash
+                transient = isinstance(e, RetryError) or default_classifier(e)
+                if attempt >= attempts or not transient:
+                    raise
+                self._recorder.record("run-retry", attempt=attempt,
+                                      error=repr(e))
+                if jax.process_index() == 0:
+                    print(
+                        f"transient training failure (attempt "
+                        f"{attempt}/{attempts}): {e!r}; re-restoring from "
+                        "the latest checkpoint",
+                        flush=True,
+                    )
+                try:
+                    # let any in-flight background save commit so the
+                    # re-restore starts from the newest durable step
+                    self._join_checkpoint_thread()
+                    self.store.wait_until_finished()
+                except Exception:
+                    pass  # the save that failed is why we are here
+
+    def _run_attempt(self) -> dict[str, Any]:
         cfg = self.cfg
         seq_len = self.model_config.seq_len
         process_count = jax.process_count()
@@ -365,17 +459,23 @@ class Trainer:
         assert total_valid > 0, "no protein sequences found for validation"
 
         state, start_seq_index, _ = self.restore_or_init()
-        # the stored cursor can point past the corpus (checkpoint taken at
-        # an epoch's last step); skip past-the-end would empty the stream —
-        # wrap to the in-epoch position (latent bug in the reference, whose
-        # tf.data skip() of >corpus yields an empty dataset, data.py:56)
-        start_seq_index = start_seq_index % total_train
+        # The stored cursor is UN-WRAPPED (monotonic across epochs).  A
+        # shuffled stream orders each corpus pass differently (the sliding
+        # buffer mixes across epoch boundaries), so resuming a multi-epoch
+        # run must skip the interrupted stream's full OUTPUT count — the
+        # wrapped first-pass position would replay epoch-1 record order.
+        # Unshuffled passes are identical, so the cheap wrapped skip is
+        # exact there and avoids decompressing whole skipped epochs.
+        # (Skip past-the-end is safe either way: the reader repeats the
+        # record stream BEFORE skipping, data/tfrecord.py.)
+        epoch_position = start_seq_index % total_train
+        skip = start_seq_index if cfg.shuffle_buffer else epoch_position
 
         # global effective batch: all hosts' micro-batches x accumulation
         effective_batch = cfg.batch_size * cfg.grad_accum_every * process_count
 
         train_it = get_train(
-            seq_len=seq_len, batch_size=cfg.batch_size, skip=start_seq_index,
+            seq_len=seq_len, batch_size=cfg.batch_size, skip=skip,
             loop=True, process_count=process_count, process_index=process_index,
             shuffle_buffer=cfg.shuffle_buffer, seed=cfg.seed,
         )
@@ -402,15 +502,30 @@ class Trainer:
         last_loss = None
         pending_tokens = 0
 
-        self._warm_compiles(state)
+        self._warm_compiles(state, global_step)
+
+        watchdog = None
+        if cfg.watchdog_timeout:
+            out_dir = cfg.watchdog_dir or str(
+                getattr(self.tracker, "_dir", None) or ".")
+            watchdog = Watchdog(
+                cfg.watchdog_timeout, out_dir=out_dir,
+                recorder=self._recorder,
+                label=f"train from step {global_step}",
+            )
+            watchdog.start()
+        self._watchdog = watchdog
 
         try:
             return self._run_loop(
-                state, train_it, valid_it, total_train, start_seq_index,
+                state, train_it, valid_it, total_train, epoch_position,
                 effective_batch, global_step, seq_cursor, last_loss,
                 pending_tokens,
             )
         finally:
+            if watchdog is not None:
+                watchdog.stop()
+            self._watchdog = None
             if isinstance(train_it, DevicePrefetcher):
                 train_it.close()
             # an exception/KeyboardInterrupt must not kill the daemon
@@ -419,7 +534,7 @@ class Trainer:
             self.store.wait_until_finished()
 
     def _run_loop(self, state, train_it, valid_it, total_train,
-                  start_seq_index, effective_batch, global_step, seq_cursor,
+                  epoch_position, effective_batch, global_step, seq_cursor,
                   last_loss, pending_tokens):
         cfg = self.cfg
         seq_len = self.model_config.seq_len
@@ -429,22 +544,38 @@ class Trainer:
         peak = peak_flops_per_chip()  # None off-TPU -> mfu not logged
         # the prefetcher already returns device arrays
         prefetched = isinstance(train_it, DevicePrefetcher)
+        watchdog = self._watchdog
 
         with profile_trace(cfg.profile_dir):
             for epoch in range(1, cfg.epochs + 1):
                 if process_index == 0:
                     print(f"==== starting epoch: {epoch} ====")
-                epoch_start = start_seq_index if epoch == 1 else 0
+                epoch_start = epoch_position if epoch == 1 else 0
                 steps_per_epoch = max(
                     1, (total_train - epoch_start) // effective_batch
                 )
                 for i in range(steps_per_epoch):
-                    for _ in range(cfg.grad_accum_every):
-                        batch = (next(train_it) if prefetched
-                                 else self._to_device(next(train_it)))
-                        state, metrics = self.fns.train_step(state, batch)
+                    if watchdog is not None:
+                        watchdog.beat(f"step {global_step + 1}")
+                    faults.inject("train.step")
+                    # the attempt's FIRST step compiles train_step inline
+                    # (its donated buffers keep it out of _warm_compiles'
+                    # execution warm-up) — minutes of legitimate stall the
+                    # watchdog must not book as a hang
+                    grace = (
+                        watchdog.paused()
+                        if watchdog is not None and epoch == 1 and i == 0
+                        else contextlib.nullcontext()
+                    )
+                    with grace:
+                        for _ in range(cfg.grad_accum_every):
+                            batch = (next(train_it) if prefetched
+                                     else self._to_device(next(train_it)))
+                            state, metrics = self.fns.train_step(state, batch)
                     global_step += 1
-                    seq_cursor = (seq_cursor + effective_batch) % total_train
+                    # monotonic, never wrapped: the checkpointed cursor must
+                    # identify the position in the multi-epoch STREAM
+                    seq_cursor = seq_cursor + effective_batch
                     pending_tokens += effective_batch * seq_len
 
                     will_hook = (
@@ -474,6 +605,7 @@ class Trainer:
                             if util is not None:
                                 log["mfu"] = util
                         self.tracker.log(log, global_step)
+                        self._recorder.record("step", step=global_step, **log)
                         if process_index == 0:
                             print(f"step {global_step} loss: {last_loss:.4f}")
 
@@ -508,6 +640,9 @@ class Trainer:
                         # hook time (eval/sampling/checkpoint IO) is not
                         # training time; drop it from the meter's window
                         self.meter.rebase()
+                        # ...nor is it a stall: re-arm the watchdog clock
+                        if watchdog is not None:
+                            watchdog.beat(f"hooks at step {global_step}")
 
                     if (self._preempt_requested
                             or self.store.reached_preemption(global_step)):
@@ -606,12 +741,16 @@ class Trainer:
             # save() skips steps already in the store, so the
             # exit/preemption save after a same-step periodic hook costs
             # nothing
+            self._recorder.record("checkpoint-start", step=step,
+                                  next_seq_index=next_seq_index)
             saved = self.store.save(
                 step, snapshot,
                 next_seq_index=next_seq_index,
                 model_config=model_config,
                 run_id=run_id,
             )
+            self._recorder.record("checkpoint-done", step=step,
+                                  saved=bool(saved))
             if saved and jax.process_index() == 0:
                 print(
                     f"checkpoint to start at sequence index of {next_seq_index}"

@@ -115,6 +115,12 @@ def test_full_model_sp_train_step_matches_single_device(devices8):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map") and jax.default_backend() == "cpu",
+    reason="XLA CPU hard-aborts (SIGABRT, no diagnostic) compiling the "
+    "fsdp+tp+sp program lowered through the legacy shard_map fallback; "
+    "the abort would kill the whole pytest process",
+)
 def test_full_model_sp_with_fsdp_tp(devices8):
     """The cp path must compose with fsdp+tp on the same mesh (partial-manual
     shard_map: seq manual, other axes GSPMD)."""

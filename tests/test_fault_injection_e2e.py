@@ -1,0 +1,220 @@
+"""Fault-injection drills through the real trainer stack (ISSUE acceptance):
+injected transient I/O during checkpointing, mid-run preemption + restart,
+a hung step tripping the watchdog, data-stream open failures, and the
+bounded crash-safe resume loop."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from progen_tpu.data import shard_filename, write_tfrecord
+from progen_tpu.models import ProGenConfig
+from progen_tpu.resilience import faults
+from progen_tpu.resilience.watchdog import WATCHDOG_EXIT_CODE, Watchdog
+from progen_tpu.train.trainer import Trainer, TrainerConfig
+
+CFG = ProGenConfig(
+    num_tokens=128, dim=16, seq_len=16, depth=2, window_size=8,
+    global_mlp_depth=1, heads=2, dim_head=8, ff_mult=2,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults(monkeypatch):
+    # near-zero backoff so drills don't sleep through the suite budget
+    for prefix in ("PROGEN_CKPT_RETRY", "PROGEN_DATA_RETRY",
+                   "PROGEN_DIST_RETRY"):
+        monkeypatch.setenv(f"{prefix}_BASE_DELAY", "0.001")
+        monkeypatch.setenv(f"{prefix}_MAX_DELAY", "0.002")
+    from progen_tpu.data import tfrecord
+
+    tfrecord._retry_policy.cache_clear()
+    faults.reset()
+    yield
+    faults.reset()
+    tfrecord._retry_policy.cache_clear()
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fault_data")
+    rng = np.random.default_rng(11)
+    mk = lambda: bytes(rng.integers(65, 90, rng.integers(6, 14)))
+    write_tfrecord(d / shard_filename(0, 48, "train"), [mk() for _ in range(48)])
+    write_tfrecord(d / shard_filename(0, 8, "valid"), [mk() for _ in range(8)])
+    return d
+
+
+def _trainer(data_dir, ckpt_dir, max_steps, **cfg_kw):
+    base = dict(
+        batch_size=2, grad_accum_every=2, epochs=50, learning_rate=1e-3,
+        validate_every=1000, sample_every=1000, checkpoint_every=1000,
+        prime_length=4, mixed_precision=False, log_every=1,
+        max_steps=max_steps,
+    )
+    base.update(cfg_kw)
+    cfg = TrainerConfig(**base)
+    return Trainer(model_config=CFG, cfg=cfg, data_path=str(data_dir),
+                   checkpoint_path=str(ckpt_dir), use_mesh=False)
+
+
+def _params(out):
+    return jax.tree.leaves(out["state"].params)
+
+
+def _assert_bit_exact(a, b):
+    for x, y in zip(_params(a), _params(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_ckpt_save_survives_injected_io_errors_bit_exact(data_dir, tmp_path):
+    """Acceptance (a): N transient errors during checkpoint save are
+    absorbed by backoff; the run completes and its params are bit-exact
+    vs the no-fault run."""
+    baseline = _trainer(data_dir, tmp_path / "ck_base", max_steps=3,
+                        checkpoint_every=2)
+    out_base = baseline.run()
+    baseline.store.close()
+
+    inj = faults.configure("ckpt.save:io_error:times=2")
+    t = _trainer(data_dir, tmp_path / "ck_fault", max_steps=3,
+                 checkpoint_every=2)
+    out = t.run()
+    assert out["step"] == 3
+    assert inj.fired("ckpt.save") == 2  # both faults actually hit the save
+    # final wait=True save landed (checkpoint steps count micro-steps)
+    assert t.store.latest_step() == 3 * 2
+    t.store.close()
+    _assert_bit_exact(out, out_base)
+
+
+def test_injected_preemption_resumes_to_same_trajectory(data_dir, tmp_path):
+    """Acceptance (b): a SIGTERM-shaped preemption mid-run checkpoints and
+    exits; a fresh process-equivalent (new Trainer) resumes and lands on
+    the SAME params/loss as the uninterrupted run."""
+    baseline = _trainer(data_dir, tmp_path / "pre_base", max_steps=6)
+    out_base = baseline.run()
+    baseline.store.close()
+
+    faults.configure("train.step:preempt:at=3")
+    t1 = _trainer(data_dir, tmp_path / "pre_fault", max_steps=6)
+    out1 = t1.run()
+    assert out1.get("preempted") is True
+    assert out1["step"] == 3
+    t1.store.close()
+
+    faults.reset()  # the restarted process has no fault plan
+    t2 = _trainer(data_dir, tmp_path / "pre_fault", max_steps=6)
+    state, start_seq, _ = t2.restore_or_init()
+    assert int(state.step) == 3 * 2  # grad_accum 2 micro-steps
+    assert start_seq == 3 * 2 * 2  # 3 steps x batch 2 x accum 2
+    out2 = t2.run()
+    t2.store.close()
+    assert out2["step"] == 6 and not out2.get("preempted")
+    assert out2["loss"] == pytest.approx(out_base["loss"], abs=0.0)
+    _assert_bit_exact(out2, out_base)
+
+
+def test_hung_step_trips_watchdog_with_artifacts(data_dir, tmp_path,
+                                                 monkeypatch):
+    """Acceptance (c): an injected hung step trips the watchdog, which
+    writes the stack dump + flight ring to the run dir and requests the
+    nonzero exit, all within its deadline."""
+    import progen_tpu.train.trainer as trainer_mod
+
+    exits = []
+
+    def wd_factory(timeout, **kw):
+        kw["exit_fn"] = exits.append  # in-process stand-in for os._exit
+        return Watchdog(timeout, **kw)
+
+    monkeypatch.setattr(trainer_mod, "Watchdog", wd_factory)
+    wd_dir = tmp_path / "wd"
+    faults.configure("train.step:hang:at=2,delay=2.5")
+    t = _trainer(data_dir, tmp_path / "wd_ck", max_steps=2,
+                 watchdog_timeout=0.5, watchdog_dir=str(wd_dir))
+    out = t.run()  # the 2.5s hang ends and the run completes in-process
+    t.store.close()
+    assert out["step"] == 2
+    assert exits == [WATCHDOG_EXIT_CODE]  # tripped before the hang ended
+    stacks = list(wd_dir.glob("watchdog_stacks_*.txt"))
+    flights = list(wd_dir.glob("watchdog_flight_*.json"))
+    assert stacks and flights
+    assert "no heartbeat" in stacks[0].read_text()
+    import json
+
+    events = json.load(open(flights[0]))["events"]
+    # the ring caught the pre-hang step with its logged loss
+    assert any(e["kind"] == "step" and "loss" in e for e in events)
+
+
+def test_data_stream_open_faults_are_retried(data_dir):
+    from progen_tpu.data import iterator_from_tfrecords_folder
+
+    inj = faults.configure("data.glob:io_error;data.open:io_error")
+    num, it_fn = iterator_from_tfrecords_folder(str(data_dir), "train")
+    assert num == 48
+    batches = []
+    for b in it_fn(seq_len=CFG.seq_len, batch_size=4):
+        batches.append(b)
+    assert len(batches) == 12
+    assert inj.fired("data.glob") == 1 and inj.fired("data.open") == 1
+
+
+def test_dist_init_retries_until_coordinator_up(monkeypatch):
+    from progen_tpu.core.mesh import initialize_distributed
+
+    calls = []
+
+    def flaky_init(**kw):
+        calls.append(kw)
+        if len(calls) == 1:
+            raise RuntimeError(
+                "DEADLINE_EXCEEDED: Barrier timed out; coordination service "
+                "UNAVAILABLE")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+    initialize_distributed()
+    assert len(calls) == 2
+
+    # "already initialized" is fatal: no second attempt
+    calls.clear()
+
+    def dup_init(**kw):
+        calls.append(kw)
+        raise RuntimeError("jax.distributed.initialize was already called")
+
+    monkeypatch.setattr(jax.distributed, "initialize", dup_init)
+    with pytest.raises(RuntimeError, match="already called"):
+        initialize_distributed()
+    assert len(calls) == 1
+
+
+def test_run_attempts_resumes_after_transient_failure(data_dir, tmp_path):
+    """The crash-safe loop: a transient mid-run failure re-restores from
+    the latest checkpoint and finishes, bit-exact vs the no-fault run."""
+    baseline = _trainer(data_dir, tmp_path / "ra_base", max_steps=4,
+                        checkpoint_every=2)
+    out_base = baseline.run()
+    baseline.store.close()
+
+    faults.configure("train.step:unavailable:at=3")
+    t = _trainer(data_dir, tmp_path / "ra_fault", max_steps=4,
+                 checkpoint_every=2, run_attempts=2)
+    out = t.run()
+    t.store.close()
+    assert out["step"] == 4
+    retries = [e for e in t._recorder.snapshot() if e["kind"] == "run-retry"]
+    assert len(retries) == 1 and "Unavailable" in retries[0]["error"]
+    _assert_bit_exact(out, out_base)
+
+
+def test_run_attempts_fatal_failure_propagates(data_dir, tmp_path):
+    faults.configure("train.step:fatal:at=1")
+    t = _trainer(data_dir, tmp_path / "fat_ck", max_steps=2, run_attempts=3)
+    with pytest.raises(faults.InjectedFatal):
+        t.run()
+    t.store.close()
+    # the fatal fault fired once: no retry burned attempts on it
+    assert faults.get().fired("train.step") == 1

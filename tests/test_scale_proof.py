@@ -1,0 +1,81 @@
+"""Coordinator-side validation in tools/scale_proof.py: the --mesh1 seq
+guard (phase 1 never runs the 'sp' strategy, so a seq>1 mesh there proves
+nothing) and the checkpoint-identity stamp (newest step-dir mtime, robust
+to orbax rewriting a step inside an existing tree)."""
+
+import argparse
+import importlib.util
+import os
+import time
+
+import pytest
+
+_SP_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "tools", "scale_proof.py")
+
+
+@pytest.fixture(scope="module")
+def sp():
+    spec = importlib.util.spec_from_file_location("scale_proof", _SP_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mesh1_seq_size_resolution(sp):
+    assert sp._mesh1_seq_size("1,4,2,1", 8) == 1
+    assert sp._mesh1_seq_size("1,2,2,2", 8) == 2
+    assert sp._mesh1_seq_size("1,2,2,-1", 8) == 2  # -1 fills to 8 devices
+    assert sp._mesh1_seq_size("2,2,2,-1", 16) == 2
+
+
+@pytest.mark.parametrize("bad", ["1,2,3", "a,b,c,d", "-1,-1,1,1", "1,3,1,-1"])
+def test_mesh1_seq_size_rejects_malformed(sp, bad):
+    with pytest.raises(ValueError):
+        sp._mesh1_seq_size(bad, 8)
+
+
+def _args(**kw):
+    base = dict(phase="1", ckpt=None, skip_save=False, config="tiny",
+                batch=8, steps=2, mesh1="1,4,2,1")
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def test_coordinate_rejects_seq_mesh_in_phase1(sp, capsys):
+    # validation happens before any tempdir/subprocess work, so this is fast
+    rc = sp.coordinate(_args(mesh1="1,2,2,2"))
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "seq=2" in err and "--phase sp" in err
+
+
+def test_coordinate_rejects_malformed_mesh1(sp, capsys):
+    rc = sp.coordinate(_args(mesh1="1,2,3"))
+    assert rc == 2
+    assert "--mesh1" in capsys.readouterr().err
+
+
+def test_ckpt_identity_tracks_newest_step_dir(sp, tmp_path):
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    for name in ("2", "4", "notastep"):
+        (ck / name).mkdir()
+    old = time.time() - 1000
+    os.utime(ck / "2", (old, old))
+    os.utime(ck / "notastep", (old + 500, old + 500))  # ignored: non-numeric
+    newest = time.time() - 10
+    os.utime(ck / "4", (newest, newest))
+    assert sp._ckpt_identity(str(ck)) == pytest.approx(newest, abs=1.0)
+
+    # orbax re-saving step 2 in place bumps that dir — identity must move
+    bumped = time.time()
+    os.utime(ck / "2", (bumped, bumped))
+    assert sp._ckpt_identity(str(ck)) == pytest.approx(bumped, abs=1.0)
+
+
+def test_ckpt_identity_empty_tree_falls_back_to_root(sp, tmp_path):
+    ck = tmp_path / "empty"
+    ck.mkdir()
+    assert sp._ckpt_identity(str(ck)) == pytest.approx(
+        os.path.getmtime(ck), abs=1.0)

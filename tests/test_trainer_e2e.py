@@ -206,3 +206,108 @@ def test_background_checkpoint_skips_when_save_in_flight(data_dir, tmp_path):
     t._checkpoint(state, 30, wait=True)      # joins, then writes
     assert calls == [0, 0]
     t.store.close()
+
+
+def _flex_trainer(data_dir, ckpt_dir, max_steps, **cfg_kw):
+    base = dict(
+        batch_size=2, grad_accum_every=2, epochs=50, learning_rate=1e-3,
+        validate_every=1000, sample_every=1000, checkpoint_every=1000,
+        prime_length=4, mixed_precision=False, log_every=1,
+        max_steps=max_steps,
+    )
+    base.update(cfg_kw)
+    return Trainer(
+        model_config=CFG, cfg=TrainerConfig(**base), data_path=str(data_dir),
+        checkpoint_path=str(ckpt_dir), use_mesh=False,
+    )
+
+
+def test_multi_epoch_shuffled_resume_is_bit_exact(tmp_path):
+    """A seeded shuffled stream orders every corpus pass differently, so a
+    resume must skip the UN-WRAPPED cursor (the full output count of the
+    interrupted stream), not the position within one epoch — the wrapped
+    skip would replay epoch-1 record order.  16-sequence corpus, 4 seqs
+    per step: interrupting at step 6 leaves the cursor at 24 > 16, well
+    into epoch 2."""
+    d = tmp_path / "tiny_corpus"
+    d.mkdir()
+    rng = np.random.default_rng(5)
+    mk = lambda: bytes(rng.integers(65, 90, rng.integers(6, 14)))
+    write_tfrecord(d / shard_filename(0, 16, "train"), [mk() for _ in range(16)])
+    write_tfrecord(d / shard_filename(0, 4, "valid"), [mk() for _ in range(4)])
+
+    shuf = dict(shuffle_buffer=8, seed=7)
+    base = _flex_trainer(d, tmp_path / "ck_base", max_steps=10, **shuf)
+    out_base = base.run()
+    base.store.close()
+
+    t1 = _flex_trainer(d, tmp_path / "ck_resume", max_steps=6, **shuf)
+    t1.run()
+    t1.store.close()
+
+    t2 = _flex_trainer(d, tmp_path / "ck_resume", max_steps=10, **shuf)
+    state, start_seq, _ = t2.restore_or_init()
+    assert int(state.step) == 6 * 2
+    assert start_seq == 6 * 4  # un-wrapped: 24 > 16-sequence corpus
+    out2 = t2.run()
+    t2.store.close()
+
+    assert out2["step"] == 10
+    for a, b in zip(jax.tree.leaves(out2["state"].params),
+                    jax.tree.leaves(out_base["state"].params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class _FakeSampler:
+    """Records warm-execution and AOT-lower calls without any real decode."""
+
+    def __init__(self):
+        self.calls = []
+        self.lowered = []
+
+    def __call__(self, params, key, prime, **kw):
+        self.calls.append(kw)
+        return jnp.zeros((1, 4), jnp.int32)
+
+    def lower(self, *a, **kw):
+        self.lowered.append(kw)
+        return self
+
+    def compile(self):
+        return self
+
+
+def test_sampler_warmup_gated_by_flag(data_dir, tmp_path):
+    """warm_sampler=False must skip the sampler's minutes-long decode
+    compile entirely (preemption restarts that sample rarely)."""
+    t = _flex_trainer(data_dir, tmp_path / "ck", max_steps=8,
+                      sample_every=4, warm_sampler=False)
+    fake = _FakeSampler()
+    t.sampler = fake
+    state, _, _ = t.restore_or_init()
+    t._warm_compiles(state, global_step=0)
+    t.store.close()
+    assert fake.calls == [] and fake.lowered == []
+
+
+def test_sampler_warmup_skipped_when_no_hook_due(data_dir, tmp_path):
+    """Resuming at step 5 of a 6-step run with sample_every=4: the next
+    sample hook (8) is past max_steps, so warming buys nothing."""
+    t = _flex_trainer(data_dir, tmp_path / "ck", max_steps=6, sample_every=4)
+    fake = _FakeSampler()
+    t.sampler = fake
+    state, _, _ = t.restore_or_init()
+    t._warm_compiles(state, global_step=5)
+    t.store.close()
+    assert fake.calls == [] and fake.lowered == []
+
+
+def test_sampler_warmup_runs_when_hook_ahead(data_dir, tmp_path):
+    """Positive control: a reachable sample hook does warm-execute."""
+    t = _flex_trainer(data_dir, tmp_path / "ck", max_steps=8, sample_every=4)
+    fake = _FakeSampler()
+    t.sampler = fake
+    state, _, _ = t.restore_or_init()
+    t._warm_compiles(state, global_step=0)
+    t.store.close()
+    assert len(fake.calls) == 1

@@ -48,6 +48,42 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 N_PROC = 8
 
 
+def _mesh1_seq_size(spec: str, n_devices: int) -> int:
+    """Resolved seq-axis size of a ``--mesh1`` spec (data,fsdp,tensor,seq;
+    one ``-1`` wildcard) — inline so the coordinator can validate without
+    importing jax (MeshConfig lives next to jax imports)."""
+    parts = spec.split(",")
+    if len(parts) != 4:
+        raise ValueError("need 4 comma-separated sizes (data,fsdp,tensor,seq)")
+    sizes = [int(p) for p in parts]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    if sizes[3] != -1:
+        return sizes[3]
+    fixed = sizes[0] * sizes[1] * sizes[2]
+    if fixed <= 0 or n_devices % fixed:
+        raise ValueError(
+            f"{n_devices} devices not divisible by fixed axes product {fixed}")
+    return n_devices // fixed
+
+
+def _ckpt_identity(ckpt_dir: str) -> float:
+    """Content identity of a checkpoint tree: mtime of the NEWEST numeric
+    step directory.  The top-level dir's mtime only moves when a step dir
+    is created or removed — orbax rewrites a re-run step INSIDE the
+    existing tree (tmp dir + rename bumps the step dir, not its parent),
+    so stamping the parent let a phase-1 rerun into the same path slip
+    past the parity guard with an unchanged "identity"."""
+    try:
+        steps = [e.path for e in os.scandir(ckpt_dir)
+                 if e.is_dir() and e.name.isdigit()]
+    except OSError:
+        steps = []
+    if steps:
+        return max(os.path.getmtime(p) for p in steps)
+    return os.path.getmtime(ckpt_dir)
+
+
 # --------------------------------------------------------------------------
 # coordinator
 
@@ -67,6 +103,19 @@ def coordinate(args) -> int:
         # would burn the hours-long phase 1 and then die at restore
         print("--skip-save is only valid with --phase 1 (later phases "
               "restore that save)", file=sys.stderr)
+        return 2
+    try:
+        mesh1_seq = _mesh1_seq_size(args.mesh1, N_PROC)
+    except ValueError as e:
+        print(f"--mesh1 {args.mesh1!r}: {e}", file=sys.stderr)
+        return 2
+    if mesh1_seq > 1:
+        # phase 1 builds the model WITHOUT 'sp' in its strategies, so a seq
+        # axis >1 never threads the shard_map CP ops — the axis would just
+        # silently dilute fsdp/tp while claiming a seq mesh in the evidence
+        print(f"--mesh1 {args.mesh1!r} resolves to seq={mesh1_seq}, but "
+              "phase 1 never runs with the 'sp' strategy; use --phase sp "
+              "for the seq-mesh proof", file=sys.stderr)
         return 2
     workdir = tempfile.mkdtemp(prefix=f"scale_proof_{args.config}_")
     print(f"[scale_proof] workdir {workdir} (phase-1 checkpoint lands in "
@@ -446,7 +495,7 @@ def worker(args) -> int:
     if args.phase in ("all", "3"):
         common["mesh_phase3"] = "data=2,fsdp=2,tensor=2"
         common["restore_ckpt_phase3"] = os.path.abspath(ckpt_dir)
-        common["restore_ckpt_mtime_phase3"] = os.path.getmtime(ckpt_dir)
+        common["restore_ckpt_mtime_phase3"] = _ckpt_identity(ckpt_dir)
         mesh2, fns2 = build(MeshConfig(data=2, fsdp=2, tensor=2))
         abstract2 = abstract_state_like(fns2)
         if total_param_bytes is None:
@@ -494,7 +543,7 @@ def worker(args) -> int:
     if args.phase == "sp":
         common["mesh_phase_sp"] = "data=1,fsdp=4,tensor=1,seq=2"
         common["restore_ckpt_sp"] = os.path.abspath(ckpt_dir)
-        common["restore_ckpt_mtime_sp"] = os.path.getmtime(ckpt_dir)
+        common["restore_ckpt_mtime_sp"] = _ckpt_identity(ckpt_dir)
         mesh_sp, fns_sp = build(MeshConfig(data=1, fsdp=4, tensor=1, seq=2),
                                 phase_strategies=("sp", "fsdp"))
         abstract_sp = abstract_state_like(fns_sp)

@@ -18,8 +18,13 @@ from progen_tpu.core.cache import honor_env_platforms
 
 honor_env_platforms()
 
-# keep stdlib tomllib (py3.11+); the reference used the third-party `toml`
-import tomllib
+# stdlib tomllib on py3.11+ (the reference used the third-party `toml`);
+# py3.10 images fall back to the API-identical `tomli` (vendored by pytest
+# and pip, so effectively always present)
+try:
+    import tomllib
+except ModuleNotFoundError:  # py < 3.11
+    import tomli as tomllib
 
 
 def _load_model_config(config_path: str, model_name: str) -> dict:
@@ -94,7 +99,26 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--profile_dir", default=None, type=str)
 @click.option("--runs_dir", default="./runs")
 @click.option("--distributed", default=False, is_flag=True,
-              help="call jax.distributed.initialize() for multi-host")
+              help="call jax.distributed.initialize() for multi-host "
+                   "(retried with backoff; see docs/RESILIENCE.md)")
+# resilience (docs/RESILIENCE.md)
+@click.option("--run_attempts", default=3,
+              help="total tries of the train loop: transient failures "
+                   "re-restore from the latest checkpoint and continue "
+                   "(1 = fail fast)")
+@click.option("--watchdog_timeout", default=None, type=float,
+              help="seconds without a completed step before the watchdog "
+                   "dumps all-thread stacks + the flight recorder to the "
+                   "run dir and exits nonzero (unset = off); size it to "
+                   "several worst-case step times")
+@click.option("--warm_sampler/--no_warm_sampler", default=True,
+              help="pre-loop sampler warm execution (minutes of decode "
+                   "compile); auto-skipped when no sample hook can fire, "
+                   "e.g. on a preemption restart near max_steps")
+@click.option("--inject-faults", "inject_faults", default=None, type=str,
+              help="arm the deterministic fault-injection harness, e.g. "
+                   "'ckpt.save:io_error:times=2;train.step:preempt:at=5' "
+                   "(testing/drills only; see docs/RESILIENCE.md)")
 # accepted for reference compatibility; the pmap flag is meaningless under
 # pjit — dp over the mesh is the default
 @click.option("--data_parallel", default=False, is_flag=True, hidden=True)
@@ -103,6 +127,10 @@ def main(**flags):
     from progen_tpu.core.cache import enable_compilation_cache
 
     enable_compilation_cache()  # restarts/resume hit the on-disk XLA cache
+    if flags["inject_faults"]:
+        from progen_tpu.resilience import faults
+
+        faults.configure(flags["inject_faults"], seed=flags["seed"])
     if flags["distributed"]:
         from progen_tpu.core.mesh import initialize_distributed
 
@@ -167,6 +195,9 @@ def main(**flags):
         log_every=flags["log_every"],
         max_steps=flags["max_steps"],
         profile_dir=flags["profile_dir"],
+        run_attempts=flags["run_attempts"],
+        watchdog_timeout=flags["watchdog_timeout"],
+        warm_sampler=flags["warm_sampler"],
     )
 
     tracker = Tracker(
