@@ -185,7 +185,65 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
     }
 
 
+def _probe_backend() -> bool:
+    """Check the accelerator backend comes up, retrying transient failures.
+
+    TPU runtime init at capture time can fail (libtpu UNAVAILABLE grpc
+    error when another process briefly holds the chips) or HANG outright
+    in its metadata fetches — and the hang holds the GIL, so the probe
+    runs ``jax.devices()`` in a SUBPROCESS (a thread-based attempt
+    timeout can never fire).  Attempts are retried via the resilience
+    layer (``PROGEN_BENCH_RETRY_*`` env knobs); when the backend still
+    won't come up, emit a parseable JSON ERROR RECORD on stdout (rc 0)
+    with a platform stamp instead of a raw traceback the driver can't
+    ingest, and return False.
+    """
+    import subprocess
+
+    from progen_tpu.resilience.retry import (
+        AttemptTimeout, RetryPolicy, retry_call,
+    )
+
+    import dataclasses
+
+    policy = RetryPolicy.from_env("PROGEN_BENCH_RETRY")
+    per_try = policy.attempt_timeout or 60.0
+    # the subprocess enforces the per-attempt bound itself — don't stack
+    # the thread-based attempt timeout on top
+    policy = dataclasses.replace(policy, attempt_timeout=None)
+
+    def probe():
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, text=True, timeout=per_try,
+            )
+        except subprocess.TimeoutExpired:
+            raise AttemptTimeout(
+                f"backend init exceeded {per_try:.0f}s") from None
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-8:]
+            raise RuntimeError("backend init failed: " + " | ".join(tail))
+
+    try:
+        retry_call(probe, policy=policy, label="backend-init")
+        return True
+    except Exception as e:  # RetryError or fatal init error: report, don't raise
+        import platform
+
+        print(json.dumps({
+            "error": f"{type(e).__name__}: {e}",
+            "metric": None,
+            "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+            "jax_version": jax.__version__,
+            "python": platform.python_version(),
+        }), flush=True)
+        return False
+
+
 def main() -> None:
+    if not _probe_backend():
+        return
     steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
     attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "pallas")
 

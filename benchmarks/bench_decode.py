@@ -1,4 +1,4 @@
-"""Autoregressive decode throughput (cached scan sampler).
+"""Autoregressive decode throughput, split by phase.
 
 The reference samples by re-running a FULL forward over the whole padded
 sequence per generated token (``/root/reference/progen_transformer/
@@ -6,6 +6,16 @@ utils.py:106-135``) — O(L) jitted full-sequence forwards.  This
 framework's sampler is one ``lax.scan`` of cached single-token steps
 (O(window) attention per token); this bench reports its tokens/sec so
 the decode path has a number, not just an asymptotic claim.
+
+Reported PER PHASE (serving cares about them separately):
+
+* **prefill** — consuming the prime.  Two implementations: the one-pass
+  parallel prefill (``decode/prefill.py``: ONE batched forward, harvest
+  caches) vs the sequential scan of single-token decode steps the
+  sampler historically used.  The speedup column is the whole point of
+  the prefill subsystem;
+* **decode** — generating new tokens after the prime (chunked early-exit
+  sampler), the steady-state serving cost per token.
 
 Timing wraps a host transfer of the sampled ids (the only trustworthy
 sync on the tunneled chip).  Usage::
@@ -53,6 +63,8 @@ def main() -> None:
                          "params sharded over it (never gathered)")
     ap.add_argument("--strategies", default="fsdp,tp",
                     help="sharding strategies when --mesh is given")
+    ap.add_argument("--chunk", type=int, default=64,
+                    help="decode steps per device program (chunked sampler)")
     args = ap.parse_args()
 
     from progen_tpu.core.cache import enable_compilation_cache
@@ -60,7 +72,13 @@ def main() -> None:
     enable_compilation_cache()
 
     from progen_tpu.core.precision import make_policy
-    from progen_tpu.decode import make_sampler
+    from progen_tpu.decode import (
+        ProGenDecodeStep,
+        init_caches,
+        make_chunked_sampler,
+        make_prefiller,
+        pad_prime_length,
+    )
     from progen_tpu.models import ProGen
     from progen_tpu.models.configs import CONFIGS
     from progen_tpu.parallel import unbox
@@ -81,35 +99,82 @@ def main() -> None:
             lambda k: unbox(model.init(k, toks))["params"],
             out_shardings=shardings,
         )(jax.random.key(0))
-        sampler = make_sampler(cfg, policy, mesh=mesh, strategies=strategies,
-                               params_shardings=shardings)
+        sampler = make_chunked_sampler(
+            cfg, policy, mesh=mesh, strategies=strategies,
+            params_shardings=shardings, chunk_size=args.chunk)
+        prefiller = make_prefiller(cfg, policy, mesh=mesh,
+                                   strategies=strategies)
         ndev = len(mesh.devices.reshape(-1))
         print(f"mesh {args.mesh} ({ndev} devices), strategies {strategies}",
               flush=True)
     else:
         params = unbox(jax.jit(model.init)(jax.random.key(0), toks))["params"]
-        sampler = make_sampler(cfg, policy)
+        sampler = make_chunked_sampler(cfg, policy, chunk_size=args.chunk)
+        prefiller = make_prefiller(cfg, policy)
+
+    # sequential prefill reference: the prime teacher-forced through the
+    # single-token decode scan — what the sampler did before prefill.py
+    step_model = ProGenDecodeStep(config=cfg, policy=policy)
+
+    @jax.jit
+    def seq_prefill(params, tokens):
+        b, p = tokens.shape
+        caches = init_caches(cfg, b, policy, decode_len=length)
+
+        def body(carry, t):
+            logits, caches = step_model.apply(
+                params, jax.lax.dynamic_index_in_dim(
+                    tokens, t, axis=1, keepdims=False), t, carry)
+            return caches, None
+
+        caches, _ = jax.lax.scan(body, caches, jnp.arange(p))
+        return caches
+
+    def timed(fn, *fn_args):
+        fn(*fn_args)  # compile + warm
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            fn(*fn_args)
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
 
     rng = np.random.default_rng(0)
     for b in args.batches:
         prime = jnp.asarray(
             rng.integers(1, cfg.num_tokens, (b, args.prime)), jnp.int32)
+        p = args.prime + 1  # + BOS, matching the sampler's add_bos path
+        p_pad = pad_prime_length(p, cfg.window_size, cfg.seq_len)
+        tokens = jnp.zeros((b, p_pad), jnp.int32).at[:, 1:p].set(prime)
+        lengths = jnp.full((b,), p, jnp.int32)
+
+        # --- prefill phase: one-pass parallel vs sequential scan ---
+        t_par = timed(lambda: jax.block_until_ready(prefiller(
+            {"params": params}, tokens, lengths, length)))
+        t_seq = timed(lambda: jax.block_until_ready(seq_prefill(
+            {"params": params}, tokens[:, :p])))
+        print(
+            f"config={args.config} batch={b} prime={p}: "
+            f"prefill one-pass {b * p / t_par:,.0f} tokens/sec "
+            f"({t_par * 1e3:.1f} ms), sequential "
+            f"{b * p / t_seq:,.0f} tokens/sec ({t_seq * 1e3:.1f} ms), "
+            f"speedup {t_seq / t_par:.1f}x",
+            flush=True,
+        )
+
+        # --- decode phase: chunked sampler minus its prefill ---
         run = lambda k: np.asarray(sampler(
             {"params": params}, k, prime, length=length, top_k=25,
             add_bos=True))
-        run(jax.random.key(1))  # compile + warm
-        times = []
-        for r in range(args.reps):
-            t0 = time.perf_counter()
-            run(jax.random.key(r))
-            times.append(time.perf_counter() - t0)
-        med = statistics.median(times)
-        new_tokens = b * (length - args.prime - 1)
+        med = timed(run, jax.random.key(1))
+        new_tokens = b * (length - p)
+        t_dec = max(med - t_par, 1e-9)
         print(
             f"config={args.config} batch={b} length={length} "
             f"prime={args.prime}: {med:.3f}s/seq-batch, "
-            f"{new_tokens / med:,.0f} sampled tokens/sec, "
-            f"{med / (length - args.prime - 1) * 1e3:.2f} ms/token",
+            f"decode {new_tokens / t_dec:,.0f} tokens/sec "
+            f"({t_dec / (length - p) * 1e3:.2f} ms/token), "
+            f"end-to-end {(new_tokens + b * p) / med:,.0f} tokens/sec",
             flush=True,
         )
 

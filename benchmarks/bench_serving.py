@@ -1,0 +1,135 @@
+"""Serving throughput/latency under a synthetic Poisson request stream.
+
+Drives :class:`progen_tpu.decode.ServingEngine` the way a server would
+be driven: requests arrive at Exp(rate) inter-arrival times with ragged
+prime lengths, are admitted into slots between decode chunks, and report
+completion latency from their ARRIVAL time (so queueing under load is
+measured, not hidden).  Prints ONE JSON line::
+
+    {"metric": "serving", "tokens_per_sec": ..., "p50_latency_s": ...,
+     "p95_latency_s": ..., "requests": N, "slots": S, "chunk": C, ...}
+
+Usage::
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_serving.py --config small \
+        --requests 16 --rate 4 --slots 4 --chunk 16 --max-new 32
+
+A warmup pass (engine compile: admission + decode chunk programs) runs
+before the clock starts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from progen_tpu.core.cache import honor_env_platforms
+
+honor_env_platforms()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="small")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="mean request arrivals per second (Poisson)")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--prime-min", type=int, default=8)
+    ap.add_argument("--prime-max", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from progen_tpu.core.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.decode import Request, ServingEngine
+    from progen_tpu.models import ProGen
+    from progen_tpu.models.configs import CONFIGS
+    from progen_tpu.parallel import unbox
+
+    cfg = CONFIGS[args.config]
+    policy = make_policy(True)
+    model = ProGen(config=cfg, policy=policy)
+    toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
+    params = unbox(jax.jit(model.init)(jax.random.key(0), toks))
+
+    rng = np.random.default_rng(args.seed)
+    pmax = min(args.prime_max, cfg.seq_len - args.max_new - 1)
+    pmin = min(args.prime_min, pmax)
+
+    def make_request(uid: int, submit_time: float) -> Request:
+        p = int(rng.integers(pmin, pmax + 1))
+        return Request(
+            uid=uid,
+            tokens=rng.integers(1, cfg.num_tokens, p).tolist(),
+            max_new_tokens=args.max_new,
+            top_k=25, temperature=1.0, seed=args.seed + uid,
+            submit_time=submit_time,
+        )
+
+    max_len = min(cfg.seq_len, pmax + args.max_new + 1)
+    engine = ServingEngine(cfg, params, policy=policy,
+                           num_slots=args.slots, chunk_size=args.chunk,
+                           max_len=max_len)
+
+    # warmup: compile the admission + chunk programs off the clock
+    for i in range(min(2, args.slots)):
+        engine.submit(make_request(10_000_000 + i, time.perf_counter()))
+    engine.run_until_idle()
+    engine.completions.clear()
+
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
+                                         size=args.requests))
+    t0 = time.perf_counter()
+    done: list = []
+    nxt = 0
+    while len(done) < args.requests:
+        now = time.perf_counter() - t0
+        while nxt < args.requests and arrivals[nxt] <= now:
+            engine.submit(make_request(nxt, t0 + arrivals[nxt]))
+            nxt += 1
+        if engine.pending == 0 and engine.num_active == 0:
+            # idle before the next arrival: sleep the gap (real servers
+            # block on the queue here)
+            time.sleep(max(0.0, arrivals[nxt] - (time.perf_counter() - t0)))
+            continue
+        done.extend(engine.step())
+    wall = time.perf_counter() - t0
+
+    latencies = sorted(c.latency for c in done)
+    gen_tokens = int(sum(len(c.tokens) for c in done))
+    record = {
+        "metric": "serving",
+        "config": args.config,
+        "requests": args.requests,
+        "rate_per_sec": args.rate,
+        "slots": args.slots,
+        "chunk": args.chunk,
+        "max_new_tokens": args.max_new,
+        "wall_s": round(wall, 3),
+        "generated_tokens": gen_tokens,
+        "tokens_per_sec": round(gen_tokens / wall, 1),
+        "p50_latency_s": round(float(np.percentile(latencies, 50)), 3),
+        "p95_latency_s": round(float(np.percentile(latencies, 95)), 3),
+        "chunks_run": engine.chunks_run,
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,32 @@
+from progen_tpu.decode.engine import Completion, Request, ServingEngine
 from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
+from progen_tpu.decode.prefill import (
+    harvest_caches,
+    make_prefiller,
+    pad_prime_length,
+)
 from progen_tpu.decode.sampler import (
     gumbel_topk_sample,
+    gumbel_topk_sample_batched,
+    make_chunked_sampler,
     make_sampler,
     teacher_forced_logits,
     truncate_after_eos,
 )
 
 __all__ = [
+    "Completion",
     "ProGenDecodeStep",
-    "init_caches",
+    "Request",
+    "ServingEngine",
     "gumbel_topk_sample",
+    "gumbel_topk_sample_batched",
+    "harvest_caches",
+    "init_caches",
+    "make_chunked_sampler",
+    "make_prefiller",
     "make_sampler",
+    "pad_prime_length",
     "teacher_forced_logits",
     "truncate_after_eos",
 ]

@@ -1,0 +1,385 @@
+"""Continuous-batching serving engine: slots, chunked decode, refill.
+
+The batch-synchronous sampler (``decode/sampler.py``) is the wrong shape
+for serving: every request in a batch waits for the slowest one, and a
+new request waits for the whole batch to drain.  This engine serves a
+request QUEUE through a fixed set of SLOTS (vLLM/Ragged-Paged-Attention
+style, PAPERS.md), with all device programs compiled once:
+
+* **slots** — a fixed-size batch of per-slot state (sequence row, decode
+  caches, position, done flag, RNG key, top-k/temperature).  Slots are
+  independent: the decode step takes a ``(S,)`` position VECTOR
+  (``ProGenDecodeStep``), so slot 3 can be at position 900 while slot 4
+  is at position 12;
+* **chunked decode** — ``chunk_size`` single-token steps per device
+  program (one compile; position/done are data, not shape).  Rows that
+  finish mid-chunk stop advancing; the host sees the done-mask between
+  chunks, so cost is bounded by emitted tokens plus at most one chunk of
+  slack per row;
+* **refill** — between chunks, finished slots are harvested (completion
+  callbacks fire) and refilled from the queue via the one-pass parallel
+  prefill (``decode/prefill.py``): queued primes are padded into a
+  ``(S, P_pad)`` ragged batch (``P_pad`` bucketed to ``window ·
+  2^k`` so admission compiles O(log) programs, then cached), prefilled
+  in ONE forward, and scattered into the free slots while live slots'
+  state rides through untouched.
+
+Determinism: each request carries its own seed; a request's token
+trajectory depends only on (params, prime, seed, sampling knobs), never
+on which slot it lands in or what else is in flight — asserted by
+``tests/test_serving.py``.
+
+Mesh-aware: pass ``mesh``/``strategies``/``params_shardings`` and the
+engine runs SPMD with params left in their training shardings and
+tp-sharded caches (``_constrain_caches``), same as the samplers.
+
+EOS convention: primes are served verbatim (no BOS prepend); generation
+stops at the first sampled pad/EOS token (id 0) or after
+``max_new_tokens``.  The reference's "second zero" truncation is a
+sampler-level concern; a serving request's prime is explicit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from collections import deque
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
+from progen_tpu.decode.prefill import (
+    _constrain_caches,
+    harvest_caches,
+    pad_prime_length,
+)
+from progen_tpu.decode.sampler import gumbel_topk_sample_batched
+from progen_tpu.models.progen import ProGen, ProGenConfig
+
+EOS_ID = 0
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``tokens``: the prime, served verbatim (encode + add BOS upstream if
+    desired); must be non-empty and leave room for at least one new
+    token.  ``top_k=None`` disables top-k; ``temperature=0`` is greedy.
+    """
+
+    uid: Any
+    tokens: Sequence[int]
+    max_new_tokens: int = 128
+    top_k: int | None = None
+    temperature: float = 1.0
+    seed: int = 0
+    on_complete: Callable[["Completion"], None] | None = None
+    submit_time: float = dataclasses.field(default_factory=time.perf_counter)
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: ``tokens`` is the generated tail only (EOS
+    included when the model emitted one)."""
+
+    uid: Any
+    prime: np.ndarray
+    tokens: np.ndarray
+    finish_reason: str  # "eos" | "length"
+    submit_time: float
+    finish_time: float
+
+    @property
+    def latency(self) -> float:
+        return self.finish_time - self.submit_time
+
+
+class ServingEngine:
+    """Slot-based continuous-batching engine over a fixed device batch.
+
+    ``num_slots`` is the max concurrent requests; ``chunk_size`` the
+    decode steps per device program; ``max_len`` the sequence budget per
+    slot (prime + generated, ≤ ``config.seq_len``).
+    """
+
+    def __init__(self, config: ProGenConfig, params, *,
+                 policy: Policy | None = None, num_slots: int = 8,
+                 chunk_size: int = 32, max_len: int | None = None,
+                 mesh: Mesh | None = None,
+                 strategies: Sequence[str] = ("dp",),
+                 params_shardings=None):
+        self.config = config
+        self.policy = policy or make_policy()
+        self.num_slots = num_slots
+        self.chunk_size = chunk_size
+        self.max_len = min(max_len or config.seq_len, config.seq_len)
+        self.mesh = mesh
+        self.strategies = tuple(strategies)
+        self._queue: deque[Request] = deque()
+        self._inflight: dict[int, Request] = {}  # slot -> request
+        self.completions: list[Completion] = []
+        self.chunks_run = 0
+
+        if params_shardings is not None:
+            params = jax.device_put(params, {"params": params_shardings})
+        self._params = params
+
+        if mesh is not None:
+            from progen_tpu.parallel.sharding import logical_rules
+
+            rules = logical_rules(self.strategies)
+
+            def trace_ctx():
+                stack = contextlib.ExitStack()
+                stack.enter_context(mesh)
+                stack.enter_context(nn.logical_axis_rules(rules))
+                return stack
+        else:
+            trace_ctx = contextlib.ExitStack
+        self._trace_ctx = trace_ctx
+
+        self._step_model = ProGenDecodeStep(config=config, policy=self.policy)
+        self._prefill_model = ProGen(config=config, policy=self.policy)
+        self._decode_chunk = jax.jit(self._decode_chunk_impl)
+        self._admit = jax.jit(self._admit_impl)
+        self.state = self._init_state()
+
+    # ---------------------------------------------------------------- state
+
+    def _init_state(self) -> dict:
+        s, L = self.num_slots, self.max_len
+        with self._trace_ctx():
+            caches = init_caches(self.config, s, self.policy, decode_len=L)
+            if self.mesh is not None:
+                caches = _constrain_caches(caches, self.mesh, self.strategies)
+        keys = jax.vmap(jax.random.key)(jnp.zeros((s,), jnp.uint32))
+        return {
+            "seq": jnp.zeros((s, L), jnp.int32),
+            "caches": caches,
+            "pos": jnp.zeros((s,), jnp.int32),     # index of newest token
+            "start": jnp.zeros((s,), jnp.int32),   # prime length
+            "stop": jnp.zeros((s,), jnp.int32),    # start + max_new (≤ L)
+            "active": jnp.zeros((s,), bool),
+            "done": jnp.zeros((s,), bool),
+            "keys": jax.random.key_data(keys),     # raw uint32 key data
+            "top_k": jnp.zeros((s,), jnp.int32),   # 0 = disabled
+            "temp": jnp.ones((s,), jnp.float32),
+        }
+
+    # ------------------------------------------------------------- decoding
+
+    def _decode_chunk_impl(self, params, state):
+        cfg = self.config
+
+        with self._trace_ctx():
+            if self.mesh is not None:
+                state = {**state, "caches": _constrain_caches(
+                    state["caches"], self.mesh, self.strategies)}
+
+            def body(st, _):
+                live = st["active"] & ~st["done"]
+                pos = st["pos"]
+                tok = jnp.take_along_axis(st["seq"], pos[:, None],
+                                          axis=1)[:, 0]
+                logits, caches = self._step_model.apply(
+                    params, tok, pos, st["caches"])
+                keys = jax.random.wrap_key_data(st["keys"])
+                split = jax.vmap(jax.random.split)(keys)  # (S, 2) keys
+                nxt = gumbel_topk_sample_batched(
+                    split[:, 1], logits, st["top_k"], st["temp"]
+                ).astype(jnp.int32)
+                writepos = jnp.clip(pos + 1, 0, self.max_len - 1)
+                cur = jnp.take_along_axis(st["seq"], writepos[:, None],
+                                          axis=1)[:, 0]
+                val = jnp.where(live, nxt, cur)
+                seq = st["seq"].at[
+                    jnp.arange(self.num_slots), writepos].set(val)
+                new_pos = jnp.where(live, pos + 1, pos)
+                done = st["done"] | (live & (
+                    (val == EOS_ID) | (new_pos + 1 >= st["stop"])))
+                # a slot's key advances only on its own live steps, so a
+                # request's trajectory is independent of its neighbours
+                new_keys = jnp.where(
+                    live[:, None], jax.random.key_data(split[:, 0]),
+                    st["keys"])
+                return {**st, "seq": seq, "caches": caches, "pos": new_pos,
+                        "done": done, "keys": new_keys}, None
+
+            state, _ = jax.lax.scan(body, state, None,
+                                    length=self.chunk_size)
+        return state
+
+    def _admit_impl(self, params, state, tokens, lengths, stops, seeds,
+                    top_k, temp, mask):
+        """Prefill ``tokens (S, P_pad)`` in one parallel forward and merge
+        rows where ``mask`` into ``state`` (rows outside ``mask`` carry
+        dummy primes and are discarded)."""
+        cfg = self.config
+        with self._trace_ctx():
+            logits, varz = self._prefill_model.apply(
+                params, tokens, mutable=["cache"])
+            caches_new = harvest_caches(cfg, varz["cache"], lengths,
+                                        self.policy, self.max_len)
+            if self.mesh is not None:
+                caches_new = _constrain_caches(caches_new, self.mesh,
+                                               self.strategies)
+
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0].astype(jnp.float32)
+        keys = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
+        split = jax.vmap(jax.random.split)(keys)
+        first = gumbel_topk_sample_batched(
+            split[:, 1], last, top_k, temp).astype(jnp.int32)
+
+        s, L = self.num_slots, self.max_len
+        p_pad = tokens.shape[1]
+        # p_pad is window-aligned and may overshoot L; real tokens never do
+        # (submit enforces prime + 1 <= max_len), so truncation drops pad only
+        tok_L = tokens[:, :L] if p_pad >= L else jnp.pad(
+            tokens, ((0, 0), (0, L - p_pad)))
+        seq = tok_L * (jnp.arange(L)[None, :] < lengths[:, None])
+        seq = seq.at[jnp.arange(s), lengths].set(first)
+        pos = lengths
+        done = (first == EOS_ID) | (pos + 1 >= stops)
+
+        def merge(new, old):
+            m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
+            return jnp.where(m, new, old)
+
+        merged_caches = jax.tree.map(merge, caches_new, state["caches"])
+        return {
+            "seq": merge(seq, state["seq"]),
+            "caches": merged_caches,
+            "pos": merge(pos, state["pos"]),
+            "start": merge(lengths, state["start"]),
+            "stop": merge(stops, state["stop"]),
+            "active": merge(jnp.ones((s,), bool), state["active"]),
+            "done": merge(done, state["done"]),
+            "keys": merge(jax.random.key_data(split[:, 0]), state["keys"]),
+            "top_k": merge(top_k, state["top_k"]),
+            "temp": merge(temp, state["temp"]),
+        }
+
+    # ----------------------------------------------------------------- API
+
+    def submit(self, request: Request) -> None:
+        n = len(request.tokens)
+        if n < 1:
+            raise ValueError(f"request {request.uid!r}: empty prime")
+        if n + 1 > self.max_len:
+            raise ValueError(
+                f"request {request.uid!r}: prime length {n} leaves no room "
+                f"for generation (max_len {self.max_len})"
+            )
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"request {request.uid!r}: max_new_tokens must be >= 1")
+        self._queue.append(request)
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def num_active(self) -> int:
+        return len(self._inflight)
+
+    def _admit_pending(self) -> None:
+        free = [i for i in range(self.num_slots) if i not in self._inflight]
+        if not free or not self._queue:
+            return
+        batch: list[tuple[int, Request]] = []
+        while free and self._queue:
+            batch.append((free.pop(0), self._queue.popleft()))
+
+        s = self.num_slots
+        longest = max(len(r.tokens) for _, r in batch)
+        p_pad = pad_prime_length(longest, self.config.window_size,
+                                 self.config.seq_len, bucket=True)
+        tokens = np.zeros((s, p_pad), np.int32)
+        lengths = np.ones((s,), np.int32)  # dummy rows: 1-token prime
+        stops = np.full((s,), 2, np.int32)
+        seeds = np.zeros((s,), np.uint32)
+        top_k = np.zeros((s,), np.int32)
+        temp = np.ones((s,), np.float32)
+        mask = np.zeros((s,), bool)
+        for slot, r in batch:
+            t = np.asarray(r.tokens, np.int32)
+            tokens[slot, : len(t)] = t
+            lengths[slot] = len(t)
+            stops[slot] = min(len(t) + r.max_new_tokens, self.max_len)
+            seeds[slot] = np.uint32(int(r.seed) & 0xFFFFFFFF)
+            top_k[slot] = 0 if r.top_k is None else int(r.top_k)
+            temp[slot] = float(r.temperature)
+            mask[slot] = True
+            self._inflight[slot] = r
+
+        self.state = self._admit(
+            self._params, self.state, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(stops), jnp.asarray(seeds),
+            jnp.asarray(top_k), jnp.asarray(temp), jnp.asarray(mask))
+
+    def _harvest_done(self) -> list[Completion]:
+        done = np.asarray(self.state["done"])
+        active = np.asarray(self.state["active"])
+        ready = [i for i in range(self.num_slots)
+                 if done[i] and active[i] and i in self._inflight]
+        if not ready:
+            return []
+        seq = np.asarray(self.state["seq"])
+        pos = np.asarray(self.state["pos"])
+        start = np.asarray(self.state["start"])
+        out = []
+        now = time.perf_counter()
+        act = self.state["active"]
+        for i in ready:
+            r = self._inflight.pop(i)
+            toks = seq[i, start[i]: pos[i] + 1].copy()
+            reason = "eos" if (toks.size and toks[-1] == EOS_ID) else "length"
+            comp = Completion(
+                uid=r.uid, prime=np.asarray(r.tokens, np.int32),
+                tokens=toks, finish_reason=reason,
+                submit_time=r.submit_time, finish_time=now)
+            out.append(comp)
+            if r.on_complete is not None:
+                r.on_complete(comp)
+            act = act.at[i].set(False)
+        self.state = {**self.state, "active": act}
+        self.completions.extend(out)
+        return out
+
+    def step(self) -> list[Completion]:
+        """One engine iteration: admit queued requests into free slots,
+        decode one chunk, harvest newly finished slots."""
+        self._admit_pending()
+        completed = self._harvest_done()  # instant EOS/length at admission
+        if self._inflight:
+            self.state = self._decode_chunk(self._params, self.state)
+            self.chunks_run += 1
+            completed += self._harvest_done()
+        return completed
+
+    def run_until_idle(self, max_chunks: int | None = None) -> list[Completion]:
+        """Drain the queue and all in-flight slots; returns completions in
+        finish order."""
+        out: list[Completion] = []
+        chunks0 = self.chunks_run
+        while self._queue or self._inflight:
+            out.extend(self.step())
+            if (max_chunks is not None
+                    and self.chunks_run - chunks0 >= max_chunks):
+                raise RuntimeError(
+                    f"engine exceeded {max_chunks} chunks without draining "
+                    f"({self.num_active} active, {self.pending} pending)"
+                )
+        return out

@@ -50,8 +50,19 @@ def _shift_with_carry(h, prev):
 
 
 def _rotate_at(x, sin_row, cos_row):
-    """Rotary for one position: ``x (..., d)``, table rows ``(d,)``."""
+    """Rotary for one position per row: ``x (B, h, d)``, table rows
+    ``(B, d)`` (each row at its own position)."""
+    sin_row = sin_row[:, None, :]
+    cos_row = cos_row[:, None, :]
     return x * cos_row + rotate_every_two(x) * sin_row
+
+
+def _update_rows(cache, update, idx, axis):
+    """Per-row ``dynamic_update_index_in_dim``: write ``update[b]`` into
+    ``cache[b]`` at row ``idx[b]`` along ``axis`` (of the per-row view)."""
+    return jax.vmap(
+        lambda c, u, i: jax.lax.dynamic_update_index_in_dim(c, u, i, axis)
+    )(cache, update, idx)
 
 
 def init_caches(config: ProGenConfig, batch_size: int,
@@ -112,12 +123,14 @@ class LocalAttentionDecode(nn.Module):
         q, k, v = (t.reshape(b, h, d) for t in (q, k, v))
         q, k, v = (_rotate_at(t, sin_row, cos_row) for t in (q, k, v))
 
-        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k, slot, axis=2)
-        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v, slot, axis=2)
+        # per-row ring slot (rows may sit at different positions — the
+        # continuous-batching engine drives one step with a (B,) pos vector)
+        k_cache = _update_rows(k_cache, k, slot, axis=1)
+        v_cache = _update_rows(v_cache, v, slot, axis=1)
 
         sim = jnp.einsum("bhd,bhsd->bhs", q, k_cache,
                          preferred_element_type=jnp.float32) * (d ** -0.5)
-        sim = jnp.where(valid[None, None, :], sim, ATTN_MASK_VALUE)
+        sim = jnp.where(valid[:, None, :], sim, ATTN_MASK_VALUE)
         attn = jax.nn.softmax(sim, axis=-1).astype(v_cache.dtype)
         out = jnp.einsum("bhs,bhsd->bhd", attn, v_cache).reshape(b, inner)
         out = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
@@ -152,20 +165,15 @@ class SGUDecode(nn.Module):
 
         # the cache may be shorter than seq_len (short-decode fast path);
         # only weight columns < n_cache can be causally live since pos
-        # stays < n_cache for the whole decode
+        # stays < n_cache for the whole decode.  ``pos`` is (B,): each row
+        # reads its own weight row / bias and masks at its own position.
         n_cache = gate_cache.shape[1]
-        gate_cache = jax.lax.dynamic_update_index_in_dim(
-            gate_cache, gate, pos, axis=1
-        )
-        w_row = jax.lax.dynamic_index_in_dim(
-            weights.astype(jnp.float32), pos, axis=0, keepdims=False
-        )[:n_cache]
-        causal = (jnp.arange(n_cache) <= pos).astype(jnp.float32)
-        w_row = w_row * causal
-        mixed = jnp.einsum("bnd,n->bd", gate_cache.astype(jnp.float32), w_row)
-        bias_m = jax.lax.dynamic_index_in_dim(
-            biases.astype(jnp.float32), pos, axis=0, keepdims=False
-        )  # (1,)
+        gate_cache = _update_rows(gate_cache, gate, pos, axis=0)
+        w_rows = weights.astype(jnp.float32)[pos][:, :n_cache]  # (B, n_cache)
+        causal = (jnp.arange(n_cache)[None, :] <= pos[:, None])
+        w_rows = w_rows * causal.astype(jnp.float32)
+        mixed = jnp.einsum("bnd,bn->bd", gate_cache.astype(jnp.float32), w_rows)
+        bias_m = biases.astype(jnp.float32)[pos]  # (B, 1)
         mixed = (mixed + bias_m).astype(x.dtype)
 
         x = x * mixed
@@ -214,8 +222,10 @@ class FeedForwardDecode(nn.Module):
 class ProGenDecodeStep(nn.Module):
     """One decode step: ``(tok (B,), pos, caches) -> (logits (B, V), caches)``.
 
-    ``pos`` is a traced scalar; every shape is static, so the step nests
-    under ``lax.scan``/``jit`` without retracing.
+    ``pos`` is a traced scalar OR a ``(B,)`` vector — the serving engine
+    steps a batch of slots each at its OWN position (continuous batching);
+    a scalar broadcasts to all rows.  Every shape is static, so the step
+    nests under ``lax.scan``/``jit`` without retracing.
     """
 
     config: ProGenConfig
@@ -226,6 +236,7 @@ class ProGenDecodeStep(nn.Module):
         cfg, pol = self.config, self.policy
         wsz = cfg.window_size
         ring = 2 * wsz
+        b = tok.shape[0]
 
         x = nn.Embed(
             cfg.num_tokens, cfg.dim,
@@ -235,20 +246,21 @@ class ProGenDecodeStep(nn.Module):
             name="embed",
         )(tok)
 
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
         sin_t, cos_t = fixed_pos_embedding(cfg.seq_len, cfg.dim_head)
-        sin_row = sin_t[pos].astype(pol.compute_dtype)
+        sin_row = sin_t[pos].astype(pol.compute_dtype)  # (B, dim_head)
         cos_row = cos_t[pos].astype(pol.compute_dtype)
         slot = pos % ring
 
-        s = jnp.arange(ring)
-        p_s = pos - jnp.mod(pos - s, ring)
-        w_start = (pos // wsz) * wsz
+        s = jnp.arange(ring)[None, :]
+        p_s = pos[:, None] - jnp.mod(pos[:, None] - s, ring)
+        w_start = ((pos // wsz) * wsz)[:, None]
         # NOTE no ``p_s >= 0`` clause: in window 0 the reference attends a
         # phantom ZERO-pad previous window (progen.py:90-95) whose keys
         # contribute exp(0 - max) to the softmax denominator; ring slots
         # with negative p_s are untouched zeros, which reproduces that
         # exactly.
-        valid = p_s >= w_start - wsz
+        valid = p_s >= w_start - wsz  # (B, ring)
 
         new: dict[str, Any] = {
             "attn_prev": list(caches["attn_prev"]),

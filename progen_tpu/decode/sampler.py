@@ -30,11 +30,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from progen_tpu.core.precision import Policy, make_policy
 from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
+from progen_tpu.decode.prefill import (
+    _constrain_caches,
+    make_prefiller,
+    pad_prime_length,
+)
 from progen_tpu.models.progen import ProGenConfig
 
 
 def gumbel_topk_sample(key, logits, top_k: int | None, temperature: float = 1.0):
-    """Sample token ids ``(B,)`` from logits ``(B, V)``."""
+    """Sample token ids ``(B,)`` from logits ``(B, V)``.
+
+    Runs in f32 regardless of the logits dtype: bf16 logits under a tiny
+    temperature overflow to inf (and the ``-inf`` top-k mask then yields
+    ``inf - inf = NaN`` rows), so the division, masking and gumbel noise
+    all happen after an f32 cast.
+    """
+    logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
@@ -45,6 +57,30 @@ def gumbel_topk_sample(key, logits, top_k: int | None, temperature: float = 1.0)
     return jnp.argmax(logits + noise, axis=-1)
 
 
+def gumbel_topk_sample_batched(keys, logits, top_k, temperature):
+    """Per-row sampling for the serving engine: each row has its own key,
+    top-k and temperature.
+
+    ``keys``: ``(B,)`` typed PRNG keys; ``logits``: ``(B, V)``; ``top_k``:
+    ``(B,)`` int32, ``0`` disables top-k for that row; ``temperature``:
+    ``(B,)`` f32, ``0.0`` means greedy for that row.  Dynamic per-row k
+    uses a full sort instead of ``lax.top_k`` (whose k is static) — V is
+    small (vocab 256) so the sort is noise next to the model step.
+    """
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-8)[:, None]
+    k_eff = jnp.where(top_k > 0, jnp.clip(top_k, 1, v), v)
+    srt = jnp.sort(scaled, axis=-1)  # ascending
+    kth = jnp.take_along_axis(srt, (v - k_eff)[:, None], axis=-1)
+    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    noise = jax.vmap(
+        lambda k: jax.random.gumbel(k, (v,), jnp.float32))(keys)
+    sampled = jnp.argmax(masked + noise, axis=-1)
+    return jnp.where(temperature == 0.0, greedy, sampled)
+
+
 def truncate_after_eos(seq, pad_id: int = 0):
     """Zero everything after the SECOND zero (reference ``utils.py:131-134``:
     the BOS/pad at position 0 is the first; the next zero is the learned
@@ -53,28 +89,9 @@ def truncate_after_eos(seq, pad_id: int = 0):
     return seq * (~after)
 
 
-def _constrain_caches(caches, mesh: Mesh, strategies: Sequence[str]):
-    """Pin the decode caches' layouts over the mesh.
-
-    Only tensor parallelism shards real decode state: the k/v rings split
-    on heads and the SGU gate cache on its channel half, matching the tp
-    rule table (``parallel/sharding.py``) so the per-step attention and
-    gate contractions stay local to each tensor shard.  Everything else
-    (tiny per-block carries) replicates — decode batches are small and
-    fsdp's win is the PARAMS staying sharded, which they do via
-    ``params_shardings``.
-    """
-    if "tp" not in strategies or mesh.shape.get("tensor", 1) <= 1:
-        return caches
-    wsc = jax.lax.with_sharding_constraint
-    kv = NamedSharding(mesh, PartitionSpec(None, "tensor", None, None))
-    gate = NamedSharding(mesh, PartitionSpec(None, None, "tensor"))
-    return {
-        **caches,
-        "k": [wsc(x, kv) for x in caches["k"]],
-        "v": [wsc(x, kv) for x in caches["v"]],
-        "sgu_gate": {k: wsc(v, gate) for k, v in caches["sgu_gate"].items()},
-    }
+# _constrain_caches moved to decode/prefill.py (shared by the prefill
+# harvest, the chunked sampler and the serving engine); re-exported here
+# for back-compat.
 
 
 def make_sampler(config: ProGenConfig, policy: Policy | None = None,
@@ -182,6 +199,147 @@ def make_sampler(config: ProGenConfig, policy: Policy | None = None,
 
     sharded_sample.lower = sample.lower  # AOT warm-compile hook
     return sharded_sample
+
+
+def make_chunked_sampler(config: ProGenConfig, policy: Policy | None = None,
+                         mesh: Mesh | None = None,
+                         strategies: Sequence[str] = ("dp",),
+                         params_shardings=None, chunk_size: int = 64):
+    """Build the serving-grade sampler: one-pass prefill + early-exit
+    chunked decode.  Same signature and trajectory semantics as
+    :func:`make_sampler` — same key ⇒ same sampled tokens — but:
+
+    * the prime is processed by ONE batched parallel forward
+      (``decode/prefill.py``) instead of P sequential decode steps;
+    * decode runs in fixed-size chunks (static shapes — exactly one
+      compiled chunk program, position passed dynamically); between
+      chunks the HOST checks a per-row done-mask and stops as soon as
+      every row has emitted EOS, so cost tracks emitted tokens, not
+      ``length``.
+
+    The done bookkeeping mirrors ``truncate_after_eos``: a row is done
+    once it holds two zeros (BOS/pad + learned EOS); later steps for that
+    row write pad.  The returned function exposes ``last_num_chunks``
+    (chunks executed by the most recent call) for tests/benchmarks.
+    """
+    policy = policy or make_policy()
+    step_model = ProGenDecodeStep(config=config, policy=policy)
+    prefiller = make_prefiller(config, policy, mesh=mesh, strategies=strategies)
+
+    if mesh is not None:
+        from progen_tpu.parallel.sharding import logical_rules
+
+        rules = logical_rules(strategies)
+
+        def trace_ctx():
+            stack = contextlib.ExitStack()
+            stack.enter_context(mesh)
+            stack.enter_context(nn.logical_axis_rules(rules))
+            return stack
+    else:
+        trace_ctx = contextlib.ExitStack
+
+    @partial(jax.jit,
+             static_argnames=("length", "start_pos", "top_k", "temperature"))
+    def start_state(key, prime, last_logits, length, start_pos, top_k,
+                    temperature):
+        b = prime.shape[0]
+        seq = jnp.zeros((b, length), jnp.int32)
+        seq = jax.lax.dynamic_update_slice(seq, prime.astype(jnp.int32), (0, 0))
+        # burn the key splits the sequential sampler spends on the prime
+        # positions so the trajectory is bit-identical to make_sampler
+        if start_pos > 1:
+            def burn(k, _):
+                return jax.random.split(k)[0], None
+            key, _ = jax.lax.scan(burn, key, None, length=start_pos - 1)
+        key, sub = jax.random.split(key)
+        first = gumbel_topk_sample(sub, last_logits, top_k,
+                                   temperature).astype(jnp.int32)
+        zcount = jnp.sum(prime == 0, axis=1).astype(jnp.int32)
+        if start_pos < length:
+            val = jnp.where(zcount > 1, 0, first)
+            seq = seq.at[:, start_pos].set(val)
+            zcount = zcount + (val == 0)
+        return seq, key, zcount
+
+    @partial(jax.jit,
+             static_argnames=("length", "start_pos", "top_k", "temperature"))
+    def decode_chunk(params, seq, caches, key, zcount, pos0, length,
+                     start_pos, top_k, temperature):
+        with trace_ctx():
+            if mesh is not None:
+                caches = _constrain_caches(caches, mesh, strategies)
+
+            def body(carry, i):
+                seq, caches, key, zcount = carry
+                pos = jnp.minimum(pos0 + i, length - 1)
+                tok = jax.lax.dynamic_index_in_dim(seq, pos, axis=1,
+                                                   keepdims=False)
+                logits, caches = step_model.apply(params, tok, pos, caches)
+                key, sub = jax.random.split(key)
+                nxt = gumbel_topk_sample(sub, logits, top_k,
+                                         temperature).astype(jnp.int32)
+                val = jnp.where(zcount > 1, 0, nxt)
+                raw = pos0 + i + 1
+                write = (raw >= start_pos) & (raw < length)
+                idx = jnp.minimum(raw, length - 1)
+                cur = jax.lax.dynamic_index_in_dim(seq, idx, axis=1,
+                                                   keepdims=False)
+                out = jnp.where(write, val, cur)
+                seq = jax.lax.dynamic_update_index_in_dim(seq, out, idx,
+                                                          axis=1)
+                zcount = zcount + jnp.where(write, (out == 0).astype(
+                    jnp.int32), 0)
+                return (seq, caches, key, zcount), None
+
+            (seq, caches, key, zcount), _ = jax.lax.scan(
+                body, (seq, caches, key, zcount), jnp.arange(chunk_size))
+        return seq, caches, key, zcount, jnp.all(zcount > 1)
+
+    def sample(params, key, prime, length, top_k=None, add_bos=False,
+               temperature=1.0):
+        if prime.ndim != 2:
+            raise ValueError(f"prime must be (B, P), got {prime.shape}")
+        if params_shardings is not None:
+            params = jax.device_put(params, {"params": params_shardings})
+        b, p = prime.shape
+        prime = jnp.asarray(prime, jnp.int32)
+        if add_bos:
+            prime = jnp.concatenate(
+                [jnp.zeros((b, 1), prime.dtype), prime[:, : length - 1]],
+                axis=1)
+            p = min(p + 1, length)
+        start_pos = p
+        if not (0 < start_pos <= length <= config.seq_len):
+            raise ValueError(
+                f"need 0 < prime length {start_pos} <= length {length} <= "
+                f"seq_len {config.seq_len}"
+            )
+
+        p_pad = pad_prime_length(start_pos, config.window_size, config.seq_len)
+        tokens = jnp.pad(prime, ((0, 0), (0, p_pad - start_pos)))
+        lengths = jnp.full((b,), start_pos, jnp.int32)
+        last_logits, caches = prefiller(params, tokens, lengths,
+                                        decode_len=length)
+        seq, key, zcount = start_state(
+            key, prime, last_logits, length, start_pos, top_k, temperature)
+
+        n_chunks = 0
+        pos = start_pos
+        while pos < length:
+            seq, caches, key, zcount, done = decode_chunk(
+                params, seq, caches, key, zcount, pos, length, start_pos,
+                top_k, temperature)
+            n_chunks += 1
+            pos += chunk_size
+            if bool(done):
+                break
+        sample.last_num_chunks = n_chunks
+        return truncate_after_eos(seq)
+
+    sample.last_num_chunks = 0
+    sample.chunk_size = chunk_size
+    return sample
 
 
 def teacher_forced_logits(config: ProGenConfig, params, tokens,

@@ -136,6 +136,12 @@ class LocalAttention(nn.Module):
         inner = h * d
 
         x = _norm(self.policy, name="norm")(x)
+        # post-norm PRE-shift activations: the decode token-shift carry
+        # (harvested by decode/prefill.py when the "cache" collection is
+        # mutable; a no-op otherwise, and skipped at init so the variable
+        # tree stays params-only)
+        if not self.is_initializing():
+            self.sow("cache", "prev", x)
         if self.shift:
             x = shift_tokens(x)
 
@@ -157,6 +163,11 @@ class LocalAttention(nn.Module):
         q = nn.with_logical_constraint(q, ("act_batch", "act_heads", "act_seq", None))
         k = nn.with_logical_constraint(k, ("act_batch", "act_heads", "act_seq", None))
         v = nn.with_logical_constraint(v, ("act_batch", "act_heads", "act_seq", None))
+        # post-rotary k/v per position: exactly what the decode ring buffers
+        # hold (decode/incremental.py) — prefill harvests these
+        if not self.is_initializing():
+            self.sow("cache", "k", k)
+            self.sow("cache", "v", v)
 
         if self.mesh is not None and self.attn_impl == "pallas":
             # pallas_call has no GSPMD rule — run it full-manual over the
@@ -212,6 +223,10 @@ class SGU(nn.Module):
         n = self.seq_len
         x, gate = jnp.split(x, 2, axis=-1)
         gate = _norm(self.policy, name="norm")(gate)
+        # normed gate activations per position: the decode SGU gate cache
+        # rows (decode/incremental.py SGUDecode) — prefill harvests these
+        if not self.is_initializing():
+            self.sow("cache", "gate", gate)
 
         init_scale = self.eps / n
 
@@ -235,9 +250,18 @@ class SGU(nn.Module):
             self.policy.param_dtype,
         )
 
+        # inputs shorter than seq_len (one-pass prefill of a prime) use the
+        # leading L rows/cols of the learned causal weights — exact, since
+        # row m only ever reads columns <= m < L
+        L = gate.shape[-2]
         if _cp_active(self.mesh):
             from progen_tpu.parallel.context import cp_spatial_gate
 
+            if L != n:
+                raise ValueError(
+                    f"context-parallel SGU requires the full seq_len {n}, "
+                    f"got length {L}"
+                )
             gate = cp_spatial_gate(
                 gate,
                 weights.astype(self.policy.compute_dtype),
@@ -245,8 +269,10 @@ class SGU(nn.Module):
                 mesh=self.mesh,
             )
         else:
-            gate = spatial_gate(gate, weights.astype(self.policy.compute_dtype),
-                                biases.astype(self.policy.compute_dtype))
+            w = weights[:L, :L] if L != n else weights
+            b = biases[:L] if L != n else biases
+            gate = spatial_gate(gate, w.astype(self.policy.compute_dtype),
+                                b.astype(self.policy.compute_dtype))
         x = x * gate
         return _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
                       policy=self.policy, name="proj_out")(x)
@@ -274,6 +300,8 @@ class FeedForward(nn.Module):
         hidden = self.dim * self.ff_mult * (2 if self.glu else 1)
 
         x = _norm(self.policy, name="norm")(x)
+        if not self.is_initializing():
+            self.sow("cache", "prev", x)
         if self.shift:
             x = shift_tokens(x)
 
@@ -338,11 +366,11 @@ class ProGen(nn.Module):
                 "leading batch dim"
             )
         b, n = tokens.shape
-        if cfg.global_mlp_depth > 0 and n != cfg.seq_len:
+        if cfg.global_mlp_depth > 0 and n > cfg.seq_len:
             raise ValueError(
-                f"input length {n} != config.seq_len {cfg.seq_len}: the gMLP "
-                "layers' learned (seq_len, seq_len) spatial weights fix the "
-                "sequence length"
+                f"input length {n} > config.seq_len {cfg.seq_len}: the gMLP "
+                "layers' learned (seq_len, seq_len) spatial weights have no "
+                "rows past seq_len"
             )
 
         x = nn.Embed(

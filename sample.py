@@ -29,8 +29,17 @@ honor_env_platforms()
                    "SPMD — required when the model does not fit one chip")
 @click.option("--strategies", default="fsdp",
               help="comma list of sharding strategies for --mesh restores")
+@click.option("--serve", is_flag=True,
+              help="decode through the continuous-batching engine instead of "
+                   "the batch-synchronous sampler: primes (split --prime on "
+                   "'|', or --num_samples copies) become queued requests, "
+                   "prefilled in one parallel forward and decoded in early-"
+                   "exit chunks (docs/SERVING.md)")
+@click.option("--slots", default=8, help="engine: max concurrent requests")
+@click.option("--chunk", default=32, help="engine: decode steps per device "
+                                          "program between refill points")
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
-         seq_len, mesh_spec, strategies):
+         seq_len, mesh_spec, strategies, serve, slots, chunk):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -85,6 +94,26 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
     print(f"params: {num_params:,}")
     print(f"sequence length: {seq_len}")
     print(f"trained for {max(meta['next_seq_index'], 0)} sequences")
+
+    if serve:
+        from progen_tpu.decode import Request, ServingEngine
+
+        primes = prime.split("|") if "|" in prime else [prime] * num_samples
+        engine = ServingEngine(
+            model_config, {"params": params}, policy=policy,
+            num_slots=slots, chunk_size=chunk, max_len=seq_len,
+            mesh=mesh, strategies=strategy_list, params_shardings=param_sh)
+        for i, p in enumerate(primes):
+            toks = [0] + encode_tokens(p)  # BOS-prefixed, like add_bos
+            engine.submit(Request(
+                uid=i, tokens=toks, max_new_tokens=seq_len - len(toks),
+                top_k=top_k, temperature=temperature, seed=seed + i))
+        completions = engine.run_until_idle()
+        for comp in sorted(completions, key=lambda c: c.uid):
+            print(f"\n {primes[comp.uid]} \n", "*" * 40,
+                  f"[{comp.finish_reason}, {len(comp.tokens)} tokens, "
+                  f"{comp.latency:.2f}s]\n", decode_tokens(comp.tokens))
+        return
 
     prime_tokens = encode_tokens(prime)
     prime_length = len(prime_tokens) + 1  # + BOS
