@@ -16,6 +16,8 @@ Env overrides: PROGEN_BENCH_CONFIG (default "small"),
 PROGEN_BENCH_BATCH (default 8), PROGEN_BENCH_STEPS (default 10),
 PROGEN_BENCH_ATTN ("xla" | "pallas", default "pallas" — measured faster
 at every config, see benchmarks/attention.md),
+PROGEN_BENCH_SGU ("xla" | "pallas", default "pallas" — blocked-causal
+fused SGU kernel, see benchmarks/sgu.md),
 PROGEN_BENCH_REMAT ("0"/"1", default on for base/large/xl),
 PROGEN_BENCH_PEAK_TFLOPS (FALLBACK for unrecognized device kinds only —
 known TPU generations auto-resolve from
@@ -70,7 +72,8 @@ LADDER = {
 
 
 def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
-            mode: str, remat: bool, remat_policy: str) -> dict:
+            sgu_impl: str, mode: str, remat: bool,
+            remat_policy: str) -> dict:
     from progen_tpu.core.mesh import MeshConfig, make_mesh
     from progen_tpu.core.precision import make_policy
     from progen_tpu.models import ProGen
@@ -86,10 +89,11 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
 
     # pallas on a >1-chip mesh must run full-manual inside shard_map — the
     # model needs the mesh (same rule the Trainer applies).
+    needs_mesh = attn_impl == "pallas" or sgu_impl == "pallas"
     model = ProGen(config=cfg, policy=make_policy(mixed_precision=True),
-                   attn_impl=attn_impl, remat=remat,
+                   attn_impl=attn_impl, sgu_impl=sgu_impl, remat=remat,
                    remat_policy=remat_policy,
-                   mesh=mesh if attn_impl == "pallas" else None)
+                   mesh=mesh if needs_mesh else None)
     sample = jnp.zeros((batch, cfg.seq_len), jnp.int32)
 
     rng = np.random.default_rng(0)
@@ -160,7 +164,8 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
     peak = float(os.environ.get(
         "PROGEN_BENCH_PEAK_TFLOPS", PEAK_BF16_TFLOPS.get(kind, 197.0)
     )) * 1e12
-    mfu = model_flops_per_token(cfg, num_params) * tps_chip / peak
+    mfu = (model_flops_per_token(cfg, num_params, sgu_impl=sgu_impl)
+           * tps_chip / peak)
 
     return {
         "metric": (
@@ -168,7 +173,7 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
             f"{'train' if mode == 'train' else 'fwd+bwd (no optimizer)'}"
             f" throughput, ProGen-{config_name} "
             f"(seq_len {cfg.seq_len}, batch {batch}, bf16, "
-            f"{attn_impl} attn"
+            f"{attn_impl} attn, {sgu_impl} sgu"
             f"{(', remat:' + remat_policy) if remat else ''}, "
             f"{n_chips} chip(s))"
         ),
@@ -182,6 +187,7 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
         ),
         "mfu": round(mfu, 4),
         "params": num_params,
+        "sgu_impl": sgu_impl,
     }
 
 
@@ -246,6 +252,7 @@ def main() -> None:
         return
     steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
     attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "pallas")
+    sgu_impl = os.environ.get("PROGEN_BENCH_SGU", "pallas")
 
     ladder = os.environ.get("PROGEN_BENCH_CONFIGS")
     if ladder:
@@ -263,8 +270,8 @@ def main() -> None:
                 spec.update(mode="train")
             print(json.dumps(run_one(
                 name, batch=spec["batch"], steps=steps,
-                attn_impl=attn_impl, mode=spec["mode"], remat=spec["remat"],
-                remat_policy=spec["remat_policy"],
+                attn_impl=attn_impl, sgu_impl=sgu_impl, mode=spec["mode"],
+                remat=spec["remat"], remat_policy=spec["remat_policy"],
             )), flush=True)
         return
 
@@ -275,6 +282,7 @@ def main() -> None:
         batch=int(os.environ.get("PROGEN_BENCH_BATCH", "8")),
         steps=steps,
         attn_impl=attn_impl,
+        sgu_impl=sgu_impl,
         mode=os.environ.get("PROGEN_BENCH_MODE", "train"),
         remat=os.environ.get("PROGEN_BENCH_REMAT",
                              "1" if remat_default else "0") == "1",

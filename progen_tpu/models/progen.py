@@ -216,6 +216,7 @@ class SGU(nn.Module):
     dim_out: int
     policy: Policy
     eps: float = 1e-3
+    sgu_impl: str = "xla"  # "xla" | "pallas" (blocked-causal fused kernel)
     mesh: Mesh | None = None  # seq axis >1 -> sharded spatial matmul
 
     @nn.compact
@@ -250,11 +251,20 @@ class SGU(nn.Module):
             self.policy.param_dtype,
         )
 
+        if self.sgu_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown sgu_impl {self.sgu_impl!r}; use 'xla' or 'pallas'"
+            )
+
         # inputs shorter than seq_len (one-pass prefill of a prime) use the
         # leading L rows/cols of the learned causal weights — exact, since
         # row m only ever reads columns <= m < L
         L = gate.shape[-2]
         if _cp_active(self.mesh):
+            # cp_spatial_gate owns the op under sequence parallelism (the
+            # all-gather + row-sharded matmul IS the sp decomposition);
+            # sgu_impl="pallas" deliberately falls back here rather than
+            # mis-sharding the blocked kernel across the seq axis.
             from progen_tpu.parallel.context import cp_spatial_gate
 
             if L != n:
@@ -268,12 +278,29 @@ class SGU(nn.Module):
                 biases.astype(self.policy.compute_dtype),
                 mesh=self.mesh,
             )
+            x = x * gate
         else:
             w = weights[:L, :L] if L != n else weights
             b = biases[:L] if L != n else biases
-            gate = spatial_gate(gate, w.astype(self.policy.compute_dtype),
-                                b.astype(self.policy.compute_dtype))
-        x = x * gate
+            w = w.astype(self.policy.compute_dtype)
+            b = b.astype(self.policy.compute_dtype)
+            if self.sgu_impl == "pallas" and self.mesh is not None:
+                # pallas_call has no GSPMD rule — run the fused kernel
+                # full-manual over the mesh (weights replicated per device)
+                from progen_tpu.parallel.context import (
+                    sharded_pallas_spatial_gate,
+                )
+
+                x = sharded_pallas_spatial_gate(x, gate, w, b, mesh=self.mesh)
+            elif self.sgu_impl == "pallas":
+                # fused res * (tril(W) @ gate + b): the mixed tensor never
+                # round-trips HBM and upper-triangle blocks are skipped
+                from progen_tpu.ops.pallas_sgu import pallas_spatial_gate
+
+                x = pallas_spatial_gate(x, gate, w, b)
+            else:
+                gate = spatial_gate(gate, w, b)
+                x = x * gate
         return _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
                       policy=self.policy, name="proj_out")(x)
 
@@ -292,6 +319,7 @@ class FeedForward(nn.Module):
     use_sgu: bool
     shift: bool
     policy: Policy
+    sgu_impl: str = "xla"
     mesh: Mesh | None = None
 
     @nn.compact
@@ -317,7 +345,8 @@ class FeedForward(nn.Module):
 
         if self.use_sgu:
             x = SGU(seq_len=self.seq_len, dim_out=hidden // 2,
-                    policy=self.policy, mesh=self.mesh, name="sgu")(x)
+                    policy=self.policy, sgu_impl=self.sgu_impl,
+                    mesh=self.mesh, name="sgu")(x)
 
         return _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
                       policy=self.policy, name="proj_out")(x)
@@ -350,6 +379,7 @@ class ProGen(nn.Module):
     remat: bool = False
     remat_policy: str = "full"  # "full" | "dots"
     attn_impl: str = "xla"  # "xla" | "pallas" (TPU windowed flash kernel)
+    sgu_impl: str = "xla"  # "xla" | "pallas" (blocked-causal fused SGU kernel)
     # With a mesh whose 'seq' axis is >1, sequence mixing (attention windows,
     # SGU spatial matmul) runs through the explicit context-parallel ops
     # (shard_map + ppermute/all_gather) instead of relying on GSPMD to invent
@@ -433,6 +463,7 @@ class ProGen(nn.Module):
                 use_sgu=use_gmlp,
                 shift=cfg.shift_tokens,
                 policy=self.policy,
+                sgu_impl=self.sgu_impl,
                 mesh=self.mesh,
                 name=f"ff{i}",
             )(x)

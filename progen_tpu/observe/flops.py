@@ -22,14 +22,30 @@ PEAK_BF16_TFLOPS = {
 }
 
 
-def model_flops_per_token(cfg, num_params: int) -> float:
+def model_flops_per_token(cfg, num_params: int,
+                          sgu_impl: str = "xla") -> float:
     """Training FLOPs (fwd+bwd) per token: the standard 6N for every dense
-    parameter (the SGU spatial weights are parameters, so 6N covers them)
-    plus the windowed-attention score/value matmuls, which touch 2*wsz keys
-    per query: fwd 8*wsz*inner FLOPs/token/layer, x3 with the backward."""
+    parameter plus the windowed-attention score/value matmuls, which touch
+    2*wsz keys per query: fwd 8*wsz*inner FLOPs/token/layer, x3 with the
+    backward.
+
+    The SGU spatial ``(n, n)`` weights are parameters but their matmul
+    contracts over TOKENS, not features — 6N would charge 6·n² per token
+    where the real cost is 6·n·(d_ff/2) per token (dense) — so they are
+    pulled out of 6N and charged by the matmul actually executed:
+    ``2·n²·(d_ff/2)`` per sequence forward for the dense xla einsum, half
+    that for the blocked-causal pallas kernel (upper-triangle blocks are
+    skipped; ``ops/pallas_sgu.py``), x3 with the backward.
+    """
     inner = cfg.heads * cfg.dim_head
     attn = 24.0 * cfg.window_size * inner * cfg.depth
-    return 6.0 * num_params + attn
+    n_gmlp = min(cfg.global_mlp_depth, cfg.depth)
+    n = cfg.seq_len
+    d_half = cfg.dim * cfg.ff_mult // 2
+    spatial_params = n_gmlp * (n * n + n)  # weights + biases per gmlp layer
+    causal = 0.5 if sgu_impl == "pallas" else 1.0
+    sgu = 6.0 * n * d_half * causal * n_gmlp  # 3 x fwd 2·n·d_half per token
+    return 6.0 * (num_params - spatial_params) + attn + sgu
 
 
 def peak_flops_per_chip(device=None) -> float | None:

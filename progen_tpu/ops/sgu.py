@@ -13,11 +13,22 @@ upper triangle are hard zeros — matching the reference's parameterization.
 
 from __future__ import annotations
 
+import functools
+
 import jax.numpy as jnp
 
 
+@functools.lru_cache(maxsize=8)
+def _causal_mask_f32(n: int):
+    # one f32 mask per seq length for the whole process; callers cast —
+    # rebuilding (and re-tril'ing) an (n, n) constant per call at the
+    # weights' dtype was pure waste under jit too (fresh consts per trace)
+    return jnp.tril(jnp.ones((n, n), dtype=jnp.float32))
+
+
 def causal_mask(n: int, dtype=jnp.float32):
-    return jnp.tril(jnp.ones((n, n), dtype=dtype))
+    m = _causal_mask_f32(n)
+    return m if dtype == jnp.float32 else m.astype(dtype)
 
 
 def spatial_gate(gate, weights, biases):
@@ -28,8 +39,10 @@ def spatial_gate(gate, weights, biases):
     the learned weights start at ~1e-6 scale (init U(±eps/n)), far below
     bf16 resolution around 1.0.
     """
-    n = weights.shape[0]
-    w = weights * causal_mask(n, weights.dtype)
+    # tril directly on the weights: same hard-zero parameterization (upper
+    # triangle grads stay exactly zero through the tril transpose) without
+    # materializing a separate (n, n) mask operand in the step
+    w = jnp.tril(weights)
     mixed = jnp.einsum(
         "...nd,mn->...md", gate, w, preferred_element_type=jnp.float32
     )

@@ -175,6 +175,54 @@ def sharded_pallas_local_attention(
     )(q, k, v)
 
 
+def sharded_pallas_spatial_gate(
+    res, gate, weights, biases, *, mesh: Mesh, seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"), d_axis: str = "tensor",
+):
+    """The blocked-causal Pallas SGU kernel under a sharded mesh.
+
+    Like :func:`sharded_pallas_local_attention`, ``pl.pallas_call`` has no
+    GSPMD partitioning rule, so the kernel runs inside a FULL-manual
+    shard_map: batch over ``batch_axes``, the hidden ``d`` over ``d_axis``,
+    weights/biases REPLICATED (every device runs the full ``(n, n)``
+    triangle against its batch/d slice — the spatial matmul contracts over
+    sequence, so the seq axis cannot shard it; fsdp's row-sharding of the
+    stored params is re-gathered by ZeRO-3 before apply anyway).
+
+    Sequence parallelism is NOT supported here: ``cp_spatial_gate`` owns
+    the op when the mesh's seq axis is >1 (the model falls back to it) —
+    this wrapper raises rather than silently mis-sharding.
+
+    Weight/bias gradients: shard_map's transpose inserts the psum over all
+    mesh axes for replicated (``P()``) inputs itself — verified empirically
+    for this jax version, including with a custom_vjp inside — so the
+    kernel's ``reduce_axes`` stays empty (an explicit psum would double
+    count).
+    """
+    from progen_tpu.ops.pallas_sgu import pallas_spatial_gate
+
+    if mesh.shape[seq_axis] != 1:
+        raise ValueError(
+            f"pallas SGU cannot run under sequence parallelism (mesh "
+            f"{seq_axis!r} axis has size {mesh.shape[seq_axis]}); use "
+            "sgu_impl='xla' so cp_spatial_gate owns the op"
+        )
+    interp = mesh.devices.flat[0].platform != "tpu"
+
+    def inner(res_loc, gate_loc, w, b):
+        return pallas_spatial_gate(res_loc, gate_loc, w, b, interpret=interp)
+
+    spec = P(batch_axes, None, d_axis)
+    # check_vma=False for the same reason as sharded_pallas_local_attention:
+    # pallas_call outputs carry no varying-mesh-axes metadata.
+    return _shard_map(
+        inner, mesh=mesh,
+        in_specs=(spec, spec, P(), P()),
+        out_specs=spec,
+        check_vma=False,
+    )(res, gate, weights, biases)
+
+
 def cp_spatial_gate(
     gate, weights, biases, *, mesh: Mesh, seq_axis: str = "seq"
 ):

@@ -115,7 +115,8 @@ def count_params(cfg) -> int:
 
 
 def _layer_saved_bytes(cfg, tokens: int, policy: str, attn_impl: str,
-                       gmlp: bool, act: int, tensor: int = 1) -> int:
+                       gmlp: bool, act: int, tensor: int = 1,
+                       sgu_impl: str = "xla") -> int:
     """Bytes of forward tensors kept for the backward of ONE layer
     (attention block + feed-forward block), per remat policy.
 
@@ -147,7 +148,11 @@ def _layer_saved_bytes(cfg, tokens: int, policy: str, attn_impl: str,
     saved += t * hidden * act             # ff proj_in
     saved += t * d * act                  # ff proj_out
     if gmlp:
-        saved += t * half * act           # sgu spatial matmul output
+        if sgu_impl != "pallas":
+            # the fused pallas kernel's VJP keeps only its inputs (already
+            # counted below/as block args) and recomputes mixed blockwise —
+            # the (t, half) mixed tensor never exists outside VMEM
+            saved += t * half * act       # sgu spatial matmul output
         saved += t * half * act           # sgu proj_out
     if policy == "dots":
         return saved
@@ -179,6 +184,7 @@ def plan(
     remat: bool = False,
     remat_policy: str = "full",
     attn_impl: str = "pallas",
+    sgu_impl: str = "xla",
     mixed_precision: bool = True,
     grad_accum_every: int = 1,
     checkpoint_snapshot: bool = False,
@@ -214,11 +220,11 @@ def plan(
     for i in range(cfg.depth):
         gmlp = cfg.layer_uses_gmlp(i)
         act_b += _layer_saved_bytes(cfg, tokens, policy, attn_impl, gmlp, act,
-                                    tensor)
+                                    tensor, sgu_impl)
         peak_layer = max(
             peak_layer,
             _layer_saved_bytes(cfg, tokens, "none", attn_impl, gmlp, act,
-                               tensor),
+                               tensor, sgu_impl),
         )
     if policy in ("full", "attn"):
         # the backward replays one block at a time: its full live set
@@ -235,6 +241,7 @@ def plan(
         "state_shard_ways": state_shard,
         "remat": policy,
         "attn_impl": attn_impl,
+        "sgu_impl": sgu_impl,
     }
     # Trainer's background checkpointing keeps one extra on-device copy of
     # the full state while the save's device->host fetch runs

@@ -86,6 +86,7 @@ class TrainerConfig:
     remat: bool = False
     remat_policy: str = "full"  # "full" | "dots" | "attn" (ProGen.remat_policy)
     attn_impl: str = "xla"  # "xla" | "pallas"
+    sgu_impl: str = "xla"  # "xla" | "pallas" (blocked-causal fused SGU)
     # input-feed double buffering: batches transferred to device ahead of
     # the step that consumes them (0 = synchronous reference-style feed)
     prefetch_depth: int = 2
@@ -151,17 +152,20 @@ class Trainer:
             )
         # The model needs the mesh when sequence mixing must be explicit:
         # sp routes attention/SGU through the context-parallel ops, and
-        # pallas attention always runs full-manual inside shard_map on a
+        # pallas attention/SGU always run full-manual inside shard_map on a
         # mesh (pallas_call has no GSPMD partitioning rule).
         cp_mesh = (
             self.mesh
             if self.mesh is not None
-            and ("sp" in cfg.strategies or cfg.attn_impl == "pallas")
+            and ("sp" in cfg.strategies
+                 or cfg.attn_impl == "pallas"
+                 or cfg.sgu_impl == "pallas")
             else None
         )
         self.model = ProGen(config=model_config, policy=self.policy,
                             remat=cfg.remat, remat_policy=cfg.remat_policy,
-                            attn_impl=cfg.attn_impl, mesh=cp_mesh)
+                            attn_impl=cfg.attn_impl, sgu_impl=cfg.sgu_impl,
+                            mesh=cp_mesh)
         self.lr_schedule = make_lr_schedule(
             cfg.lr_schedule,
             cfg.learning_rate,
@@ -191,6 +195,7 @@ class Trainer:
                 remat=cfg.remat,
                 remat_policy=cfg.remat_policy,
                 attn_impl=cfg.attn_impl,
+                sgu_impl=cfg.sgu_impl,
                 mixed_precision=cfg.mixed_precision,
                 grad_accum_every=cfg.grad_accum_every,
                 checkpoint_snapshot=(cfg.background_checkpoint
@@ -540,7 +545,8 @@ class Trainer:
         seq_len = self.model_config.seq_len
         process_index = jax.process_index()
         num_params = sum(x.size for x in jax.tree.leaves(state.params))
-        flops_per_token = model_flops_per_token(self.model_config, num_params)
+        flops_per_token = model_flops_per_token(self.model_config, num_params,
+                                                sgu_impl=cfg.sgu_impl)
         peak = peak_flops_per_chip()  # None off-TPU -> mfu not logged
         # the prefetcher already returns device arrays
         prefetched = isinstance(train_it, DevicePrefetcher)

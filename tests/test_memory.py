@@ -101,6 +101,24 @@ def test_fsdp_and_tp_shrink_the_plan():
     assert sharded.total_bytes < single.total_bytes / 8
 
 
+def test_pallas_sgu_shrinks_dots_plan():
+    """Under the dots remat policy the xla path saves the (t, half) spatial
+    matmul output per gmlp layer; the fused pallas kernel recomputes mixed
+    blockwise in its VJP, so the planner must charge less — by exactly that
+    tensor across the gmlp layers (x the dots scheduling efficiency)."""
+    cfg = CONFIGS["small"]
+    kw = dict(batch_size=8, remat=True, remat_policy="dots")
+    p_xla = plan(cfg, sgu_impl="xla", **kw)
+    p_pls = plan(cfg, sgu_impl="pallas", **kw)
+    assert p_pls.detail["sgu_impl"] == "pallas"
+    tokens = p_xla.detail["tokens_per_chip"]
+    half = (cfg.dim * cfg.ff_mult) // 2
+    mixed_bytes = int(
+        cfg.global_mlp_depth * tokens * half * 2 * 0.91)  # bf16, dots eff.
+    diff = p_xla.activation_bytes - p_pls.activation_bytes
+    assert abs(diff - mixed_bytes) <= 2  # int() rounding of the x0.91 sums
+
+
 def test_xl_v4_plan_fits_32gb():
     """The XL (6B) north-star deployment: v4-128 (32 GiB/chip), fsdp x dp,
     per-chip micro-batch 1 — the planner must say it fits."""

@@ -59,6 +59,42 @@ def test_model_flops_per_token_dominated_by_6n():
     assert f < 6.5 * n  # ...but stays a small correction at this scale
 
 
+def test_model_flops_sgu_charged_by_matmul_not_param_count():
+    """The SGU spatial (n, n) weights contract over tokens: 6N would charge
+    6·n² per token, the real dense cost is 6·n·(d_ff/2) per token.  With a
+    gmlp-heavy config the two differ wildly — the accounting must use the
+    matmul."""
+    cfg = ProGenConfig(dim=128, depth=4, heads=4, dim_head=32,
+                       window_size=64, seq_len=2048, ff_mult=4,
+                       global_mlp_depth=4)
+    n_params = 50_000_000
+    f = model_flops_per_token(cfg, n_params)
+    spatial = cfg.global_mlp_depth * (cfg.seq_len**2 + cfg.seq_len)
+    d_half = cfg.dim * cfg.ff_mult // 2
+    sgu_dense = 6.0 * cfg.seq_len * d_half * cfg.global_mlp_depth
+    attn = 24.0 * cfg.window_size * cfg.heads * cfg.dim_head * cfg.depth
+    assert f == pytest.approx(6.0 * (n_params - spatial) + attn + sgu_dense)
+
+
+def test_model_flops_pallas_sgu_halves_spatial_matmul():
+    """sgu_impl='pallas' executes only the causal half of the spatial
+    matmul (upper-triangle blocks skipped) — exactly the SGU term shrinks."""
+    cfg = ProGenConfig(dim=256, depth=6, heads=4, dim_head=64,
+                       window_size=64, seq_len=1024, ff_mult=4,
+                       global_mlp_depth=3)
+    n_params = 30_000_000
+    f_xla = model_flops_per_token(cfg, n_params, sgu_impl="xla")
+    f_pls = model_flops_per_token(cfg, n_params, sgu_impl="pallas")
+    d_half = cfg.dim * cfg.ff_mult // 2
+    sgu_dense = 6.0 * cfg.seq_len * d_half * cfg.global_mlp_depth
+    assert f_xla - f_pls == pytest.approx(sgu_dense / 2)
+    # no gmlp layers -> impl choice is a no-op
+    cfg0 = ProGenConfig(dim=256, depth=6, heads=4, dim_head=64,
+                        window_size=64, seq_len=1024, global_mlp_depth=0)
+    assert model_flops_per_token(cfg0, n_params) == model_flops_per_token(
+        cfg0, n_params, sgu_impl="pallas")
+
+
 def test_mfu_math_and_unknown_peak():
     assert mfu(40_000, 6.0 * 1.2e9, 275e12) == pytest.approx(1.047, rel=1e-2)
     assert mfu(40_000, 6.0 * 1.2e9, None) is None

@@ -86,6 +86,10 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
                    "feed-forward")
 @click.option("--attn_impl", default="xla", type=click.Choice(["xla", "pallas"]),
               help="windowed attention implementation")
+@click.option("--sgu_impl", default="xla", type=click.Choice(["xla", "pallas"]),
+              help="SGU spatial-gate implementation (pallas = blocked-causal "
+                   "fused kernel, skips upper-triangle blocks; falls back to "
+                   "the context-parallel op under sp)")
 @click.option("--prefetch_depth", default=2,
               help="device batches buffered ahead of the step consuming "
                    "them (0 = synchronous reference-style feed)")
@@ -190,6 +194,7 @@ def main(**flags):
         remat=flags["remat"],
         remat_policy=flags["remat_policy"],
         attn_impl=flags["attn_impl"],
+        sgu_impl=flags["sgu_impl"],
         prefetch_depth=flags["prefetch_depth"],
         background_checkpoint=flags["background_checkpoint"],
         log_every=flags["log_every"],
