@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from progen_tpu.observe.gitinfo import git_sha
+
 NORTH_STAR_TOKENS_PER_SEC_PER_CHIP = 40_000.0
 
 
@@ -188,6 +190,7 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
         "mfu": round(mfu, 4),
         "params": num_params,
         "sgu_impl": sgu_impl,
+        "git_sha": git_sha(),
     }
 
 
@@ -243,6 +246,7 @@ def _probe_backend() -> bool:
             "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
             "jax_version": jax.__version__,
             "python": platform.python_version(),
+            "git_sha": git_sha(),
         }), flush=True)
         return False
 

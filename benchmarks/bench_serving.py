@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from progen_tpu.observe.gitinfo import git_sha
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
@@ -127,6 +129,7 @@ def main() -> None:
         "p95_latency_s": round(float(np.percentile(latencies, 95)), 3),
         "chunks_run": engine.chunks_run,
         "platform": jax.devices()[0].platform,
+        "git_sha": git_sha(),
     }
     print(json.dumps(record), flush=True)
 

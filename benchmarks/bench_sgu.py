@@ -37,6 +37,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
+from progen_tpu.observe.gitinfo import git_sha
+
 # d = dim * ff_mult / 2 of the ProGen-small class (the gmlp hidden half)
 SWEEP_N = (512, 1024, 2048)
 DEFAULT_D = 2048
@@ -141,6 +143,7 @@ def main() -> None:
                 "blocks_executed": skip["blocks_executed"],
                 "blocks_dense": skip["blocks_dense"],
                 "flop_ratio": round(skip["ratio"], 5),
+                "git_sha": git_sha(),
             }), flush=True)
 
 

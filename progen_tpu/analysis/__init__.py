@@ -1,0 +1,46 @@
+"""graftcheck: JAX/TPU-aware static analysis for this codebase.
+
+Pure-stdlib (never imports jax); entry points:
+
+* ``tools/graftcheck.py`` — CLI with human/JSON output and CI exit codes
+* :func:`progen_tpu.analysis.engine.run` — programmatic, used by the tier-1
+  gate test
+* :func:`progen_tpu.analysis.engine.check_source` — single-snippet checks,
+  used by the per-rule unit tests
+
+Rules register themselves into ``engine.RULES`` when their module is
+imported; :func:`load_rules` imports them all.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from progen_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    RULES,
+    apply_baseline,
+    build_context,
+    check_source,
+    format_human,
+    format_json,
+    load_baseline,
+    run,
+    save_baseline,
+)
+
+_RULE_MODULES = (
+    "rules_trace",
+    "rules_rng",
+    "rules_dtype",
+    "rules_sharding",
+    "rules_hostsync",
+    "rules_jit",
+    "rules_pallas",
+)
+
+
+def load_rules() -> dict:
+    for mod in _RULE_MODULES:
+        importlib.import_module(f"progen_tpu.analysis.{mod}")
+    return RULES

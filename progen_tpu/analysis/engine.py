@@ -1,0 +1,319 @@
+"""graftcheck rule engine: findings, suppressions, baseline, runner.
+
+The analyzer is pure-stdlib AST walking — it never imports jax and never
+executes repo code, so it runs in milliseconds and is safe to call from a
+tier-1 test or a pre-push hook.  Rules live in sibling ``rules_*`` modules
+and register themselves into :data:`RULES` at import time.
+
+Suppression grammar (checked on the finding's line, then the line above if
+that line is comment-only):
+
+    x = risky()  # graftcheck: disable=host-sync
+    # graftcheck: disable=host-sync,trace-safety
+    # graftcheck: disable-file=mesh-axis        (anywhere: whole file)
+
+Baseline: ``tools/graftcheck_baseline.json`` holds accepted legacy findings
+keyed on ``(rule, path, message)`` — deliberately not the line number, so
+unrelated edits above a baselined finding don't invalidate it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Callable, Iterable, Sequence
+
+DEFAULT_MESH_AXES = frozenset({"data", "fsdp", "tensor", "seq"})
+
+_SUPPRESS_RE = re.compile(r"#\s*graftcheck:\s*disable=([\w,\-]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*graftcheck:\s*disable-file=([\w,\-]+)")
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix, relative to the analysis root
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclasses.dataclass
+class ParsedModule:
+    path: str  # posix relpath used in findings
+    source: str
+    tree: ast.Module
+    lines: list[str]
+
+
+@dataclasses.dataclass
+class RepoContext:
+    """Cross-file facts shared by all rules (currently: mesh-axis vocab)."""
+
+    root: Path
+    mesh_axes: frozenset[str] = DEFAULT_MESH_AXES
+
+
+# rule name -> callable(module, ctx) -> iterable of Finding
+RULES: dict[str, Callable[[ParsedModule, RepoContext], Iterable[Finding]]] = {}
+
+
+def rule(name: str):
+    """Decorator: register a rule function under ``name``."""
+
+    def register(fn):
+        fn.rule_name = name
+        RULES[name] = fn
+        return fn
+
+    return register
+
+
+# ---------------------------------------------------------------------------
+# context discovery
+# ---------------------------------------------------------------------------
+
+
+def _string_tuple_assigns(tree: ast.Module, names: set[str]) -> set[str]:
+    found: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not any(t in names for t in targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            elts = node.value.elts
+            if elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in elts
+            ):
+                found.update(e.value for e in elts)
+    return found
+
+
+def discover_mesh_axes(root: Path) -> frozenset[str]:
+    """Read the mesh-axis vocabulary out of core/mesh.py (AST only).
+
+    Falls back to :data:`DEFAULT_MESH_AXES` when the declaration can't be
+    found — a missing vocab must never turn every PartitionSpec into noise.
+    """
+    axes: set[str] = set()
+    for rel in ("progen_tpu/core/mesh.py", "progen_tpu/parallel/sharding.py"):
+        path = root / rel
+        if not path.is_file():
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue
+        axes |= _string_tuple_assigns(tree, {"MESH_AXES", "AXES", "MESH_AXIS_NAMES"})
+    return frozenset(axes) if axes else DEFAULT_MESH_AXES
+
+
+def build_context(root: Path) -> RepoContext:
+    return RepoContext(root=root, mesh_axes=discover_mesh_axes(root))
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+class Suppressions:
+    def __init__(self, lines: Sequence[str]):
+        self.by_line: dict[int, set[str]] = {}
+        self.file_wide: set[str] = set()
+        self._comment_only: set[int] = set()
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self.file_wide.update(m.group(1).split(","))
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self.by_line.setdefault(i, set()).update(m.group(1).split(","))
+            if _COMMENT_ONLY_RE.match(text):
+                self._comment_only.add(i)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_wide or "all" in self.file_wide:
+            return True
+        for line in (finding.line, finding.line - 1):
+            rules = self.by_line.get(line)
+            if rules is None:
+                continue
+            if line != finding.line and line not in self._comment_only:
+                continue  # trailing comment on the previous code line: no
+            if finding.rule in rules or "all" in rules:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> set[tuple[str, str, str]]:
+    data = json.loads(path.read_text())
+    return {
+        (f["rule"], f["path"], f["message"]) for f in data.get("findings", [])
+    }
+
+
+def save_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    data = {
+        "version": 1,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "message": f.message}
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: set[tuple[str, str, str]]
+) -> tuple[list[Finding], list[Finding]]:
+    """Split findings into (new, baselined)."""
+    new, old = [], []
+    for f in findings:
+        (old if f.key() in baseline else new).append(f)
+    return new, old
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules", ".venv"}
+
+
+def iter_python_files(paths: Sequence[Path]) -> Iterable[Path]:
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in sub.parts):
+                    yield sub
+
+
+def parse_module(path: Path, root: Path) -> ParsedModule | None:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError:
+        return None
+    try:
+        rel = path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    return ParsedModule(
+        path=rel, source=source, tree=tree, lines=source.splitlines()
+    )
+
+
+def check_source(
+    source: str,
+    path: str = "<string>",
+    rules: Sequence[str] | None = None,
+    ctx: RepoContext | None = None,
+) -> list[Finding]:
+    """Analyze a source string — the unit-test entry point."""
+    tree = ast.parse(source)
+    module = ParsedModule(
+        path=path, source=source, tree=tree, lines=source.splitlines()
+    )
+    return check_module(module, ctx or RepoContext(root=Path(".")), rules)
+
+
+def check_module(
+    module: ParsedModule,
+    ctx: RepoContext,
+    rules: Sequence[str] | None = None,
+) -> list[Finding]:
+    suppress = Suppressions(module.lines)
+    out: list[Finding] = []
+    for name, fn in RULES.items():
+        if rules is not None and name not in rules:
+            continue
+        for finding in fn(module, ctx):
+            if not suppress.is_suppressed(finding):
+                out.append(finding)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def run(
+    paths: Sequence[Path],
+    root: Path,
+    rules: Sequence[str] | None = None,
+) -> list[Finding]:
+    # rule modules register themselves on import; keep this lazy so that
+    # `from progen_tpu.analysis import engine` alone stays import-cycle free
+    from progen_tpu.analysis import load_rules
+
+    load_rules()
+    ctx = build_context(root)
+    findings: list[Finding] = []
+    for file in iter_python_files(paths):
+        module = parse_module(file, root)
+        if module is None:
+            findings.append(
+                Finding(
+                    rule="parse-error",
+                    path=file.as_posix(),
+                    line=1,
+                    col=0,
+                    message="file does not parse as Python",
+                )
+            )
+            continue
+        findings.extend(check_module(module, ctx, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# output
+# ---------------------------------------------------------------------------
+
+
+def format_human(findings: Sequence[Finding], baselined: int = 0) -> str:
+    lines = [
+        f"{f.path}:{f.line}:{f.col}: [{f.rule}] {f.message}" for f in findings
+    ]
+    tail = f"{len(findings)} finding(s)"
+    if baselined:
+        tail += f" ({baselined} baselined finding(s) hidden)"
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def format_json(findings: Sequence[Finding], baselined: int = 0) -> str:
+    return json.dumps(
+        {
+            "version": 1,
+            "findings": [f.to_json() for f in findings],
+            "count": len(findings),
+            "baselined": baselined,
+        },
+        indent=2,
+    )

@@ -1,0 +1,430 @@
+"""Shared AST machinery for the graftcheck rules.
+
+Three facilities:
+
+* :func:`dotted` — render ``Name``/``Attribute`` chains as ``"jax.random.split"``.
+* :class:`TraceGraph` — which function defs in a module are reachable from a
+  jit/scan/shard_map/pallas_call trace root (per-module, name-resolution by
+  simple name: precise enough for this codebase, cheap enough for tier-1).
+* :class:`StaticEnv` — per-function classification of local names into
+  host-static (shapes, ints, config) vs possibly-traced values, used by the
+  Pallas index-map rule.
+
+Everything here is best-effort and intentionally conservative in opposite
+directions per consumer: TraceGraph under-approximates reachability (only
+flags what it is sure is traced), StaticEnv under-approximates staticness
+(flags closures it cannot prove static).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+FuncDef = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``Name``/``Attribute`` chain -> dotted string, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted(node.func)
+
+
+def unwrap_partial(node: ast.AST) -> ast.AST:
+    """``functools.partial(f, ...)`` / ``partial(f, ...)`` -> ``f``."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in ("partial", "functools.partial") and node.args:
+            return unwrap_partial(node.args[0])
+    return node
+
+
+def target_simple_name(node: ast.AST) -> str | None:
+    """Simple name a callable expression refers to within this module.
+
+    ``f`` -> ``f``; ``self._step`` / ``cls._step`` -> ``_step`` (methods are
+    resolved by simple name); dotted module refs (``jax.random.split``) -> None.
+    """
+    node = unwrap_partial(node)
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        if node.value.id in ("self", "cls"):
+            return node.attr
+    return None
+
+
+_JIT_NAMES = frozenset({"jit", "jax.jit", "pjit", "jax.pjit", "nn.jit"})
+
+# higher-order jax entry points whose callable argument is traced
+_TRACING_HOFS = frozenset(
+    {
+        "lax.scan",
+        "jax.lax.scan",
+        "lax.while_loop",
+        "jax.lax.while_loop",
+        "lax.fori_loop",
+        "jax.lax.fori_loop",
+        "lax.cond",
+        "jax.lax.cond",
+        "lax.switch",
+        "jax.lax.switch",
+        "lax.map",
+        "jax.lax.map",
+        "lax.associative_scan",
+        "jax.lax.associative_scan",
+        "shard_map",
+        "jax.experimental.shard_map.shard_map",
+        "_shard_map",
+        "pl.pallas_call",
+        "pallas_call",
+        "pltpu.pallas_call",
+        "jax.vmap",
+        "vmap",
+        "jax.pmap",
+        "pmap",
+        "jax.grad",
+        "jax.value_and_grad",
+        "jax.custom_vjp",
+        "jax.custom_jvp",
+        "jax.checkpoint",
+        "jax.remat",
+        "jax.linearize",
+        "jax.vjp",
+        "jax.jvp",
+    }
+)
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    return call_name(node) in _JIT_NAMES
+
+
+def _jit_wrapped_names(node: ast.Call) -> Iterable[str]:
+    if node.args:
+        name = target_simple_name(node.args[0])
+        if name:
+            yield name
+
+
+@dataclasses.dataclass
+class JittedCallable:
+    """A name bound to a jitted callable: ``step = jax.jit(step_impl, ...)``."""
+
+    bound_name: str
+    wrapped_name: str | None
+    call: ast.Call  # the jax.jit(...) call (for static/donate kwargs)
+
+    def keyword(self, key: str) -> ast.expr | None:
+        for kw in self.call.keywords:
+            if kw.arg == key:
+                return kw.value
+        return None
+
+
+class TraceGraph:
+    """Per-module set of function defs reachable from a trace root."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: dict[str, list[FuncDef]] = {}
+        self.parent_def: dict[FuncDef, FuncDef | None] = {}
+        self.jitted: list[JittedCallable] = []
+        self._roots: set[str] = set()
+        self._collect(tree)
+        self.traced: set[FuncDef] = self._propagate()
+
+    # -- collection --------------------------------------------------------
+
+    def _collect(self, tree: ast.Module) -> None:
+        stack: list[FuncDef] = []
+
+        class V(ast.NodeVisitor):
+            def visit_FunctionDef(inner, node: FuncDef):  # noqa: N805
+                self.defs.setdefault(node.name, []).append(node)
+                self.parent_def[node] = stack[-1] if stack else None
+                for dec in node.decorator_list:
+                    target = unwrap_partial(dec)
+                    if isinstance(target, ast.Call):
+                        target = target.func
+                    if dotted(target) in _JIT_NAMES:
+                        self._roots.add(node.name)
+                stack.append(node)
+                inner.generic_visit(node)
+                stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(inner, node: ast.Call):  # noqa: N805
+                name = call_name(node)
+                if _is_jit_call(node):
+                    self._roots.update(_jit_wrapped_names(node))
+                elif name in _TRACING_HOFS:
+                    for arg in node.args:
+                        t = target_simple_name(arg)
+                        if t:
+                            self._roots.add(t)
+                inner.generic_visit(node)
+
+            def visit_Assign(inner, node: ast.Assign):  # noqa: N805
+                if isinstance(node.value, ast.Call) and _is_jit_call(node.value):
+                    wrapped = (
+                        target_simple_name(node.value.args[0])
+                        if node.value.args
+                        else None
+                    )
+                    for t in node.targets:
+                        bound = target_simple_name(t)
+                        if bound:
+                            self.jitted.append(
+                                JittedCallable(bound, wrapped, node.value)
+                            )
+                inner.generic_visit(node)
+
+        V().visit(tree)
+
+    # -- propagation -------------------------------------------------------
+
+    def _callees(self, fn: FuncDef) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                t = target_simple_name(node.func)
+                if t and t in self.defs:
+                    out.add(t)
+        return out
+
+    def _propagate(self) -> set[FuncDef]:
+        traced: set[FuncDef] = set()
+        work: list[FuncDef] = []
+        for name in self._roots:
+            work.extend(self.defs.get(name, []))
+        while work:
+            fn = work.pop()
+            if fn in traced:
+                continue
+            traced.add(fn)
+            for callee in self._callees(fn):
+                work.extend(self.defs.get(callee, []))
+        return traced
+
+    def is_traced(self, fn: FuncDef) -> bool:
+        return fn in self.traced
+
+    def jitted_by_bound_name(self) -> dict[str, JittedCallable]:
+        return {j.bound_name: j for j in self.jitted}
+
+
+# ---------------------------------------------------------------------------
+# staticness
+# ---------------------------------------------------------------------------
+
+_STATIC_ANNOTATIONS = frozenset({"int", "float", "bool", "str", "tuple"})
+
+
+class StaticEnv:
+    """Classify an enclosing function's local names as host-static or not.
+
+    Static: int/float/bool/str-annotated params, constants, ``x.shape``
+    reads and their unpackings, ``len()``, and arithmetic over static names.
+    Everything else assigned locally (in particular unannotated params —
+    they are usually arrays) is treated as possibly-traced.
+    """
+
+    def __init__(
+        self,
+        fn: FuncDef,
+        returns: dict[str, "list[bool] | bool"] | None = None,
+    ):
+        self.local: set[str] = set()  # all locally-bound names
+        self.static: set[str] = set()
+        # one level of interprocedural knowledge: per-element staticness of
+        # module-local helpers' return tuples (see module_return_staticness)
+        self.returns = returns or {}
+        self._classify(fn)
+
+    def _classify(self, fn: FuncDef) -> None:
+        args = fn.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            self.local.add(a.arg)
+            ann = a.annotation
+            if ann is not None and (
+                (isinstance(ann, ast.Name) and ann.id in _STATIC_ANNOTATIONS)
+                or (
+                    isinstance(ann, ast.Constant)
+                    and ann.value in _STATIC_ANNOTATIONS
+                )
+            ):
+                self.static.add(a.arg)
+        if fn.args.args and fn.args.args[0].arg in ("self", "cls"):
+            # self/cls are containers, not traced arrays; attribute reads on
+            # them are handled expression-side
+            self.static.discard(fn.args.args[0].arg)
+
+        # two passes so forward references between simple assignments settle
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    self._bind(node.targets, node.value)
+                elif isinstance(node, ast.AugAssign):
+                    self._bind([node.target], node.value, aug=True)
+                elif isinstance(node, (ast.For, ast.comprehension)):
+                    tgt = node.target
+                    it = node.iter
+                    names = [
+                        n.id
+                        for n in ast.walk(tgt)
+                        if isinstance(n, ast.Name)
+                    ]
+                    self.local.update(names)
+                    if self.is_static_expr(it):
+                        self.static.update(names)
+
+    def _bind(self, targets, value, aug: bool = False) -> None:
+        if not aug and isinstance(value, ast.Call):
+            info = self.returns.get(target_simple_name(value.func) or "")
+            if isinstance(info, list):
+                for t in targets:
+                    if isinstance(t, (ast.Tuple, ast.List)) and len(
+                        t.elts
+                    ) == len(info):
+                        for elt, elt_static in zip(t.elts, info):
+                            if isinstance(elt, ast.Name):
+                                self.local.add(elt.id)
+                                (
+                                    self.static.add
+                                    if elt_static
+                                    else self.static.discard
+                                )(elt.id)
+                        return
+            elif info is True:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.local.add(t.id)
+                        self.static.add(t.id)
+                return
+        static_value = self.is_static_expr(value)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.local.add(t.id)
+                if aug:
+                    if not static_value:
+                        self.static.discard(t.id)
+                elif static_value:
+                    self.static.add(t.id)
+                else:
+                    self.static.discard(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    if isinstance(elt, ast.Name):
+                        self.local.add(elt.id)
+                        if static_value:
+                            self.static.add(elt.id)
+                        else:
+                            self.static.discard(elt.id)
+
+    def is_static_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            # names never bound locally resolve to module scope (imports,
+            # module constants, helper functions): host-static by definition
+            return node.id not in self.local or node.id in self.static
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "ndim", "dtype", "size"):
+                return True
+            return self.is_static_expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_static_expr(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_static_expr(node.left) and self.is_static_expr(
+                node.right
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static_expr(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(self.is_static_expr(e) for e in node.elts)
+        if isinstance(node, ast.Compare):
+            return self.is_static_expr(node.left) and all(
+                self.is_static_expr(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return all(
+                self.is_static_expr(n) for n in (node.test, node.body, node.orelse)
+            )
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("len", "int", "min", "max", "abs", "round", "sum"):
+                return all(self.is_static_expr(a) for a in node.args)
+            return False
+        return False
+
+
+def module_return_staticness(
+    tree: ast.Module,
+) -> dict[str, "list[bool] | bool"]:
+    """Per-element staticness of single-return module-level helpers.
+
+    ``_prep`` returning ``(g, r, w, b, bsz, nbr, lead)`` yields
+    ``[False, False, False, False, True, True, True]`` — enough for a
+    caller's tuple-unpack to know that ``nbr`` is a host int.
+    """
+    out: dict[str, list[bool] | bool] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        rets = [
+            n
+            for n in ast.walk(node)
+            if isinstance(n, ast.Return) and n.value is not None
+        ]
+        if len(rets) != 1:
+            continue
+        env = StaticEnv(node)
+        value = rets[0].value
+        if isinstance(value, ast.Tuple):
+            out[node.name] = [env.is_static_expr(e) for e in value.elts]
+        else:
+            out[node.name] = env.is_static_expr(value)
+    return out
+
+
+def walk_functions(tree: ast.Module) -> Iterable[FuncDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def qualnames(tree: ast.Module) -> dict[FuncDef, str]:
+    """Map every function def to its dotted qualname (``Class.method``)."""
+    out: dict[FuncDef, str] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[child] = q
+                visit(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out

@@ -1,0 +1,117 @@
+"""Rules ``dtype-pet`` and ``dtype-f32-literal``: mixed-precision hygiene.
+
+``dtype-pet``: every ``jnp.einsum`` / ``lax.dot_general`` in the numeric
+core (``ops/``, ``decode/``) must pin ``preferred_element_type`` — on TPU
+a bf16×bf16 contraction otherwise accumulates in bf16, which is exactly
+the silent-precision-loss class the MXU's f32 accumulator exists to avoid.
+
+``dtype-f32-literal``: a Python float literal that is not exactly
+representable in bfloat16 (e.g. ``1e-6``, ``0.1``) mixed into arithmetic
+with an explicitly-bf16 operand rounds at the binding — epsilons vanish,
+scales drift.  Exact literals (``0.5``, ``2.0``) pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+import struct
+
+from progen_tpu.analysis.engine import Finding, ParsedModule, RepoContext, rule
+from progen_tpu.analysis.jaxgraph import call_name
+
+_CONTRACTIONS = frozenset(
+    {
+        "jnp.einsum",
+        "jax.numpy.einsum",
+        "np.einsum",  # misuse in ops/ would be wrong anyway; flag it
+        "lax.dot_general",
+        "jax.lax.dot_general",
+        "lax.dot",
+        "jax.lax.dot",
+    }
+)
+
+_SCOPED_DIRS = ("ops/", "decode/")
+
+
+def _in_scope(path: str) -> bool:
+    return any(f"/{d}" in path or path.startswith(d) for d in _SCOPED_DIRS)
+
+
+def bf16_exact(value: float) -> bool:
+    """True if ``value`` round-trips bfloat16 exactly (8-bit mantissa)."""
+    if not math.isfinite(value):
+        return True
+    f32 = struct.unpack(">I", struct.pack(">f", value))[0]
+    if struct.unpack(">f", struct.pack(">I", f32))[0] != value:
+        return False  # not even f32-exact
+    # round-to-nearest-even to the top 16 bits
+    lower = f32 & 0xFFFF
+    rounded = f32 & 0xFFFF0000
+    if lower > 0x8000 or (lower == 0x8000 and (f32 >> 16) & 1):
+        rounded += 0x10000
+    return struct.unpack(">f", struct.pack(">I", rounded & 0xFFFFFFFF))[0] == value
+
+
+def _mentions_bf16(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "bfloat16":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "bfloat16":
+            return True
+    return False
+
+
+@rule("dtype-pet")
+def check_pet(module: ParsedModule, ctx: RepoContext):
+    if not _in_scope(module.path):
+        return
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name not in _CONTRACTIONS:
+            continue
+        if any(kw.arg == "preferred_element_type" for kw in node.keywords):
+            continue
+        yield Finding(
+            rule="dtype-pet",
+            path=module.path,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"'{name}' without preferred_element_type: bf16 inputs "
+                "accumulate in bf16 on the MXU; pass "
+                "preferred_element_type=jnp.float32"
+            ),
+        )
+
+
+@rule("dtype-f32-literal")
+def check_literals(module: ParsedModule, ctx: RepoContext):
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.BinOp):
+            continue
+        for lit, other in (
+            (node.left, node.right),
+            (node.right, node.left),
+        ):
+            if (
+                isinstance(lit, ast.Constant)
+                and isinstance(lit.value, float)
+                and not bf16_exact(lit.value)
+                and _mentions_bf16(other)
+            ):
+                yield Finding(
+                    rule="dtype-f32-literal",
+                    path=module.path,
+                    line=lit.lineno,
+                    col=lit.col_offset,
+                    message=(
+                        f"float literal {lit.value!r} is not bf16-exact but "
+                        "mixes into bf16 arithmetic; compute in f32 and cast "
+                        "once at the end"
+                    ),
+                )
+                break

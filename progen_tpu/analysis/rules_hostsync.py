@@ -1,0 +1,223 @@
+"""Rule ``host-sync``: device→host transfers inside hot loops.
+
+Each ``float(x)`` / ``.item()`` / ``np.asarray(x)`` on a device array
+blocks the host until the dispatch queue drains — in the training loop or
+the serving engine's step path that serializes the accelerator against
+Python.  The rule watches a small set of *hot zones* (qualname patterns in
+specific files) and flags any sync primitive applied to a value it cannot
+prove is already host-side.
+
+The sanctioned idiom is one explicit, batched ``jax.device_get`` per
+decision point, annotated with a suppression so every intentional sync is
+grep-able:
+
+    host = jax.device_get(metrics)  # graftcheck: disable=host-sync
+
+Names assigned from that call (and pure-numpy derivations of them) are
+treated as host-safe, so downstream ``float(host["loss"])`` does not flag.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from progen_tpu.analysis.engine import Finding, ParsedModule, RepoContext, rule
+from progen_tpu.analysis.jaxgraph import call_name, qualnames
+
+@dataclasses.dataclass(frozen=True)
+class Zone:
+    path_re: str
+    qual_re: str
+    # self attributes known to hold host-side containers (queues, configs,
+    # request bookkeeping) — reads/method calls on them are not syncs
+    host_attrs: frozenset = frozenset()
+
+
+# the hot zones for this codebase
+HOT_ZONES: tuple[Zone, ...] = (
+    Zone(
+        r"train/trainer\.py$",
+        r"Trainer\.(_run_loop|evaluate)$",
+        frozenset({"meter", "tracker", "config", "model_config", "store",
+                   "_recorder", "lr_schedule"}),
+    ),
+    Zone(
+        r"decode/engine\.py$",
+        r"ServingEngine\.(step|submit|run_until_idle|_admit_pending|_harvest_done)$",
+        frozenset({"_inflight", "_queue", "completions", "config",
+                   "num_slots", "max_len", "chunks_run"}),
+    ),
+    Zone(r"train/step\.py$", r".*\.(train_step|eval_step)$"),
+)
+
+_SYNC_CALLS = frozenset(
+    {
+        "np.asarray",
+        "numpy.asarray",
+        "np.array",
+        "numpy.array",
+        "jax.device_get",
+        "jax.block_until_ready",
+    }
+)
+_CAST_CALLS = frozenset({"float", "int", "bool"})
+
+
+def _zone_for(path: str, qualname: str) -> Zone | None:
+    for zone in HOT_ZONES:
+        if re.search(zone.path_re, path) and re.fullmatch(
+            zone.qual_re, qualname
+        ):
+            return zone
+    return None
+
+
+def _root_name(node: ast.AST) -> str | None:
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        if isinstance(node, ast.Call):
+            node = node.func
+        else:
+            node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _HostSafe:
+    """Names provably host-side within one function (flow-insensitive)."""
+
+    def __init__(
+        self,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        host_attrs: frozenset = frozenset(),
+    ):
+        self.names: set[str] = set()
+        self.host_attrs = host_attrs
+        # fixpoint over simple assignments: device_get results and pure
+        # arithmetic/numpy over host-safe names stay host-safe
+        for _ in range(3):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if self._host_value(node.value):
+                        for t in node.targets:
+                            self._mark(t)
+                elif isinstance(node, ast.AnnAssign):
+                    if node.value is not None and self._host_value(node.value):
+                        self._mark(node.target)
+                elif isinstance(node, (ast.For, ast.comprehension)):
+                    if self._host_value(node.iter):
+                        self._mark(node.target)
+
+    def _mark(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.names.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mark(e)
+
+    def _host_value(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name == "jax.device_get":
+                return True
+            if name and (name.startswith("np.") or name.startswith("numpy.")):
+                return all(self._host_value(a) for a in node.args)
+            if name in ("len", "range", "enumerate", "zip", "min", "max", "sum"):
+                return all(self._host_value(a) for a in node.args)
+            if name in _CAST_CALLS:
+                return all(self._host_value(a) for a in node.args)
+            # a method call on a host-side object yields a host-side value
+            # (queue.popleft(), inflight.pop(i), host_arr.copy(), ...)
+            if isinstance(node.func, ast.Attribute) and self._host_value(
+                node.func.value
+            ):
+                return True
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                return node.attr in self.host_attrs
+            return self._host_value(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._host_value(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._host_value(node.left) and self._host_value(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._host_value(node.operand)
+        if isinstance(node, ast.Compare):
+            return self._host_value(node.left) and all(
+                self._host_value(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return all(self._host_value(e) for e in node.elts)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return all(self._host_value(g.iter) for g in node.generators)
+        if isinstance(node, ast.IfExp):
+            return self._host_value(node.body) and self._host_value(
+                node.orelse
+            )
+        if isinstance(node, ast.JoinedStr):
+            return True
+        return False
+
+
+@rule("host-sync")
+def check(module: ParsedModule, ctx: RepoContext):
+    quals = qualnames(module.tree)
+    for fn, qual in quals.items():
+        zone = _zone_for(module.path, qual)
+        if zone is None:
+            continue
+        safe = _HostSafe(fn, host_attrs=zone.host_attrs)
+        own_stmts = _own_nodes(fn, quals)
+        for node in own_stmts:
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            finding = None
+            if name in _SYNC_CALLS:
+                if not (node.args and safe._host_value(node.args[0])):
+                    finding = f"'{name}' forces a device sync"
+            elif name in _CAST_CALLS:
+                if node.args and not safe._host_value(node.args[0]):
+                    arg_root = _root_name(node.args[0]) or "value"
+                    finding = (
+                        f"'{name}({arg_root}…)' forces a device sync on a "
+                        "value not fetched via jax.device_get"
+                    )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("item", "block_until_ready")
+                and not safe._host_value(node.func.value)
+            ):
+                finding = f"'.{node.func.attr}()' forces a device sync"
+            if finding:
+                yield Finding(
+                    rule="host-sync",
+                    path=module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"{finding} inside hot path '{qual}'; batch into one "
+                        "explicit jax.device_get per decision point"
+                    ),
+                )
+
+
+def _own_nodes(fn, quals):
+    """Walk ``fn`` without descending into nested function defs."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out

@@ -1,0 +1,297 @@
+"""Rules ``donation`` and ``recompile``: jit boundary contracts.
+
+``donation``: ``donate_argnums`` hands the argument's buffer to XLA — the
+Python reference left behind is a zombie whose next read raises (TPU) or
+silently aliases (CPU).  The rule tracks names bound via
+``f = jax.jit(impl, donate_argnums=...)`` and flags any later *read* of a
+variable that was passed in a donated position, until it is reassigned.
+
+``recompile``: jit caches on the hash of static args and on the structure
+of traced ones — passing a config-like object as a traced arg either
+errors (unhashable leaves) or retraces per call.  Two checks: (a) a jitted
+function whose parameter looks like config/state-free metadata but is not
+listed in static_argnums/static_argnames; (b) call sites of known-jitted
+callables passing dict/list literals with string leaves or lambdas.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from progen_tpu.analysis.engine import Finding, ParsedModule, RepoContext, rule
+from progen_tpu.analysis.jaxgraph import (
+    TraceGraph,
+    call_name,
+    dotted,
+    walk_functions,
+)
+
+_CONFIG_PARAM_NAMES = frozenset(
+    {
+        "config",
+        "cfg",
+        "model_config",
+        "train_config",
+        "mesh_config",
+        "sampler_config",
+        "options",
+        "settings",
+        "policy",
+        "tokenizer",
+    }
+)
+
+
+def _static_names(jit_call: ast.Call) -> set[str]:
+    out: set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    out.add(node.value)
+    return out
+
+
+def _static_nums(jit_call: ast.Call) -> set[int]:
+    out: set[int] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, int
+                ):
+                    out.add(node.value)
+    return out
+
+
+def _donated_nums(jit_call: ast.Call) -> set[int]:
+    out: set[int] = set()
+    for kw in jit_call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, int
+                ):
+                    out.add(node.value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+
+@rule("donation")
+def check_donation(module: ParsedModule, ctx: RepoContext):
+    graph = TraceGraph(module.tree)
+    donating = {
+        j.bound_name: _donated_nums(j.call)
+        for j in graph.jitted
+        if _donated_nums(j.call)
+    }
+    if not donating:
+        return
+    for fn in walk_functions(module.tree):
+        yield from _scan_donation(fn, donating, module.path)
+
+
+def _scan_donation(fn, donating, path):
+    # linear walk of the function body: after `out = step(state, batch)`
+    # with argnum 0 donated, reads of `state` flag until it is rebound
+    donated_live: dict[str, int] = {}  # var -> line of donating call
+    for stmt in _linear_stmts(fn):
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                yield from _flag_reads(value, donated_live, path)
+                _note_donation(value, donating, donated_live)
+            targets = (
+                stmt.targets
+                if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        donated_live.pop(n.id, None)
+        elif isinstance(stmt, ast.Expr):
+            yield from _flag_reads(stmt.value, donated_live, path)
+            _note_donation(stmt.value, donating, donated_live)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            yield from _flag_reads(stmt.value, donated_live, path)
+        else:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.expr):
+                    yield from _flag_reads(sub, donated_live, path)
+                    break
+
+
+def _linear_stmts(fn):
+    """Flatten the body including if/loop bodies, skipping nested defs."""
+    stack = list(reversed(fn.body))
+    while stack:
+        stmt = stack.pop()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(reversed(getattr(stmt, field, []) or []))
+
+
+def _note_donation(expr, donating, donated_live):
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        simple = name.split(".")[-1] if name else None
+        nums = donating.get(simple)
+        if not nums:
+            continue
+        for i, arg in enumerate(node.args):
+            if i in nums and isinstance(arg, ast.Name):
+                donated_live[arg.id] = node.lineno
+
+
+def _flag_reads(expr, donated_live, path):
+    if not donated_live:
+        return
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in donated_live
+        ):
+            # the donating call itself contains the name; only flag reads
+            # on later lines
+            if node.lineno > donated_live[node.id]:
+                yield Finding(
+                    rule="donation",
+                    path=path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"'{node.id}' was donated to a jitted call on line "
+                        f"{donated_live[node.id]} and read afterwards; its "
+                        "buffer may already be reused"
+                    ),
+                )
+                donated_live.pop(node.id, None)
+                return
+
+
+# ---------------------------------------------------------------------------
+# recompile
+# ---------------------------------------------------------------------------
+
+
+@rule("recompile")
+def check_recompile(module: ParsedModule, ctx: RepoContext):
+    graph = TraceGraph(module.tree)
+    jitted_names: set[str] = set()
+
+    # (a) jitted defs taking config-like params without static markings
+    for j in graph.jitted:
+        jitted_names.add(j.bound_name)
+        if not j.wrapped_name:
+            continue
+        statics = _static_names(j.call)
+        nums = _static_nums(j.call)
+        for fn in graph.defs.get(j.wrapped_name, []):
+            params = [a.arg for a in fn.args.args]
+            if params and params[0] in ("self", "cls"):
+                params = params[1:]
+            for i, p in enumerate(params):
+                if (
+                    p in _CONFIG_PARAM_NAMES
+                    and p not in statics
+                    and i not in nums
+                ):
+                    yield Finding(
+                        rule="recompile",
+                        path=module.path,
+                        line=fn.lineno,
+                        col=fn.col_offset,
+                        message=(
+                            f"jitted function '{fn.name}' takes config-like "
+                            f"arg '{p}' without static_argnums/"
+                            "static_argnames: retraces on every new object"
+                        ),
+                    )
+
+    for fn in walk_functions(module.tree):
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if dotted(target) in ("jit", "jax.jit", "pjit", "jax.pjit"):
+                statics = (
+                    _static_names(dec) if isinstance(dec, ast.Call) else set()
+                )
+                nums = (
+                    _static_nums(dec) if isinstance(dec, ast.Call) else set()
+                )
+                params = [a.arg for a in fn.args.args]
+                if params and params[0] in ("self", "cls"):
+                    params = params[1:]
+                for i, p in enumerate(params):
+                    if (
+                        p in _CONFIG_PARAM_NAMES
+                        and p not in statics
+                        and i not in nums
+                    ):
+                        yield Finding(
+                            rule="recompile",
+                            path=module.path,
+                            line=fn.lineno,
+                            col=fn.col_offset,
+                            message=(
+                                f"jitted function '{fn.name}' takes "
+                                f"config-like arg '{p}' without "
+                                "static_argnums/static_argnames: retraces "
+                                "on every new object"
+                            ),
+                        )
+
+    # (b) call sites passing literal containers with non-array leaves
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        simple = name.split(".")[-1] if name else None
+        if simple not in jitted_names:
+            continue
+        for arg in node.args:
+            if _is_structural_literal(arg):
+                yield Finding(
+                    rule="recompile",
+                    path=module.path,
+                    line=arg.lineno,
+                    col=arg.col_offset,
+                    message=(
+                        f"literal with non-array leaves passed to jitted "
+                        f"'{simple}': strings/lambdas in a traced pytree "
+                        "error or retrace; mark the arg static or hoist it"
+                    ),
+                )
+
+
+def _is_structural_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Lambda):
+        return True
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.Tuple)):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Lambda):
+                return True
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                # a dict VALUE that is a string is config-like; dict keys
+                # are legitimate pytree structure
+                if not _is_dict_key(node, sub):
+                    return True
+    return False
+
+
+def _is_dict_key(container: ast.AST, const: ast.Constant) -> bool:
+    for sub in ast.walk(container):
+        if isinstance(sub, ast.Dict) and const in sub.keys:
+            return True
+    return False

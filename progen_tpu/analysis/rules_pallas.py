@@ -1,0 +1,149 @@
+"""Rules ``pallas-indexmap`` and ``pallas-ref-write``: kernel hygiene.
+
+``pallas-indexmap``: a ``BlockSpec`` index map runs at *trace* time to
+build the block schedule — it may close over host-static ints (block
+counts derived from shapes, annotated int params) but never over traced
+arrays; a traced closure either fails deep in lowering or bakes in a stale
+value.  Staticness of closed-over names is decided by
+:class:`~progen_tpu.analysis.jaxgraph.StaticEnv` on the enclosing function.
+
+``pallas-ref-write``: inside a kernel body, a plain ``ref[...] = value``
+store in a ``for``/``while`` loop usually means the author wanted an
+accumulation (``ref[...] += value``) or a ``pl.when``-guarded epilogue
+write; each plain store clobbers the block written by the previous
+iteration.  Stores outside loops, augmented stores, and read-modify-write
+stores are the accepted idioms and pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from progen_tpu.analysis.engine import Finding, ParsedModule, RepoContext, rule
+from progen_tpu.analysis.jaxgraph import (
+    StaticEnv,
+    call_name,
+    module_return_staticness,
+    target_simple_name,
+    walk_functions,
+)
+
+_BLOCKSPEC_NAMES = frozenset(
+    {"pl.BlockSpec", "pltpu.BlockSpec", "BlockSpec", "pallas.BlockSpec"}
+)
+_PALLAS_CALL_NAMES = frozenset(
+    {"pl.pallas_call", "pltpu.pallas_call", "pallas_call"}
+)
+
+
+def _uses_pallas(module: ParsedModule) -> bool:
+    return "pallas" in module.source
+
+
+def _lambda_free_names(lam: ast.Lambda) -> set[str]:
+    bound = {a.arg for a in lam.args.args + lam.args.kwonlyargs}
+    free: set[str] = set()
+    for node in ast.walk(lam.body):
+        if isinstance(node, ast.Name) and node.id not in bound:
+            free.add(node.id)
+    return free
+
+
+@rule("pallas-indexmap")
+def check_indexmap(module: ParsedModule, ctx: RepoContext):
+    if not _uses_pallas(module):
+        return
+    returns = module_return_staticness(module.tree)
+    for fn in walk_functions(module.tree):
+        env = None  # built lazily, once per enclosing function
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _BLOCKSPEC_NAMES:
+                continue
+            lams = [a for a in node.args if isinstance(a, ast.Lambda)]
+            lams += [
+                kw.value
+                for kw in node.keywords
+                if kw.arg == "index_map" and isinstance(kw.value, ast.Lambda)
+            ]
+            for lam in lams:
+                if env is None:
+                    env = StaticEnv(fn, returns=returns)
+                for name in sorted(_lambda_free_names(lam)):
+                    if name in env.local and name not in env.static:
+                        yield Finding(
+                            rule="pallas-indexmap",
+                            path=module.path,
+                            line=lam.lineno,
+                            col=lam.col_offset,
+                            message=(
+                                f"BlockSpec index_map closes over '{name}', "
+                                "which is not provably host-static; index "
+                                "maps may only capture shapes/ints known at "
+                                "trace time"
+                            ),
+                        )
+
+
+def _kernel_defs(module: ParsedModule) -> set[str]:
+    """Names of functions passed (possibly via partial) to pallas_call."""
+    kernels: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and call_name(node) in _PALLAS_CALL_NAMES:
+            if node.args:
+                name = target_simple_name(node.args[0])
+                if name:
+                    kernels.add(name)
+            for kw in node.keywords:
+                if kw.arg in (None, "kernel", "f"):
+                    name = target_simple_name(kw.value)
+                    if name:
+                        kernels.add(name)
+    return kernels
+
+
+@rule("pallas-ref-write")
+def check_ref_writes(module: ParsedModule, ctx: RepoContext):
+    if not _uses_pallas(module):
+        return
+    kernels = _kernel_defs(module)
+    if not kernels:
+        return
+    for fn in walk_functions(module.tree):
+        if fn.name not in kernels:
+            continue
+        params = {a.arg for a in fn.args.args}
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for stmt in ast.walk(loop):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Subscript):
+                        continue
+                    base = t.value
+                    if not (
+                        isinstance(base, ast.Name) and base.id in params
+                    ):
+                        continue
+                    # read-modify-write of the same ref is an accumulation
+                    reads_self = any(
+                        isinstance(n, ast.Name) and n.id == base.id
+                        for n in ast.walk(stmt.value)
+                    )
+                    if reads_self:
+                        continue
+                    yield Finding(
+                        rule="pallas-ref-write",
+                        path=module.path,
+                        line=stmt.lineno,
+                        col=stmt.col_offset,
+                        message=(
+                            f"plain store to kernel ref '{base.id}' inside "
+                            "a loop clobbers previous iterations; use "
+                            "'ref[...] += ...' or guard the epilogue write "
+                            "with pl.when"
+                        ),
+                    )

@@ -1,0 +1,52 @@
+"""Rule ``mesh-axis``: PartitionSpec axis names must exist in the mesh.
+
+GSPMD silently replicates a dimension whose PartitionSpec names an axis the
+mesh does not declare (or errors late, deep inside pjit lowering).  Both
+failure modes are expensive on real hardware, so the check is static: every
+string literal inside a ``PartitionSpec(...)`` / ``P(...)`` call — including
+nested tuples like ``P(("data", "fsdp"), None)`` — must be a member of the
+vocabulary scraped from ``core/mesh.py``.  Non-literal axis expressions
+(variables, ``*axes`` splats) are skipped: the rule only judges what it can
+read.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from progen_tpu.analysis.engine import Finding, ParsedModule, RepoContext, rule
+from progen_tpu.analysis.jaxgraph import call_name
+
+_SPEC_NAMES = frozenset({"P", "PartitionSpec", "jax.sharding.PartitionSpec"})
+
+
+def _literal_axes(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _literal_axes(elt)
+
+
+@rule("mesh-axis")
+def check(module: ParsedModule, ctx: RepoContext):
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) not in _SPEC_NAMES:
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in args:
+            for axis, lit in _literal_axes(arg):
+                if axis not in ctx.mesh_axes:
+                    known = ", ".join(sorted(ctx.mesh_axes))
+                    yield Finding(
+                        rule="mesh-axis",
+                        path=module.path,
+                        line=lit.lineno,
+                        col=lit.col_offset,
+                        message=(
+                            f"PartitionSpec axis '{axis}' is not a declared "
+                            f"mesh axis (known: {known})"
+                        ),
+                    )

@@ -1,0 +1,61 @@
+"""Rule ``trace-safety``: host-side impurities inside traced code.
+
+``random.*`` / ``np.random.*`` draw from untracked host state, ``time.*``
+reads the host clock, and ``print`` fires once at trace time — all silent
+no-ops or wrong under jit.  Flag them only in functions the per-module
+trace graph proves reachable from a jit/scan/shard_map/pallas root; host
+driver code (training loop, CLI, benchmarks) may use them freely.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from progen_tpu.analysis.engine import Finding, ParsedModule, RepoContext, rule
+from progen_tpu.analysis.jaxgraph import TraceGraph, call_name
+
+_BAD_PREFIXES = ("random.", "np.random.", "numpy.random.", "time.")
+
+_SUGGESTION = {
+    "print": "use jax.debug.print inside traced code",
+    "time": "host clocks are trace-time constants under jit; time outside "
+    "the jitted function",
+    "random": "thread a jax.random key through the function instead",
+}
+
+
+def _suggest(name: str) -> str:
+    if name == "print":
+        return _SUGGESTION["print"]
+    if name.startswith("time."):
+        return _SUGGESTION["time"]
+    return _SUGGESTION["random"]
+
+
+@rule("trace-safety")
+def check(module: ParsedModule, ctx: RepoContext):
+    graph = TraceGraph(module.tree)
+    if not graph.traced:
+        return
+    seen: set[int] = set()
+    for fn in graph.traced:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            if name == "print" or any(
+                name.startswith(p) for p in _BAD_PREFIXES
+            ):
+                seen.add(id(node))
+                yield Finding(
+                    rule="trace-safety",
+                    path=module.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"'{name}' called inside traced function "
+                        f"'{fn.name}': {_suggest(name)}"
+                    ),
+                )

@@ -330,15 +330,17 @@ class ServingEngine:
             jnp.asarray(top_k), jnp.asarray(temp), jnp.asarray(mask))
 
     def _harvest_done(self) -> list[Completion]:
-        done = np.asarray(self.state["done"])
-        active = np.asarray(self.state["active"])
+        # two-phase fetch: one small transfer of the per-slot flags gates
+        # the call (the common case is "nothing finished"); the big seq
+        # buffer only crosses the wire when some slot actually completed
+        done, active = jax.device_get(  # graftcheck: disable=host-sync
+            (self.state["done"], self.state["active"]))
         ready = [i for i in range(self.num_slots)
                  if done[i] and active[i] and i in self._inflight]
         if not ready:
             return []
-        seq = np.asarray(self.state["seq"])
-        pos = np.asarray(self.state["pos"])
-        start = np.asarray(self.state["start"])
+        seq, pos, start = jax.device_get(  # graftcheck: disable=host-sync
+            (self.state["seq"], self.state["pos"], self.state["start"]))
         out = []
         now = time.perf_counter()
         act = self.state["active"]

@@ -132,7 +132,10 @@ class LocalAttentionDecode(nn.Module):
                          preferred_element_type=jnp.float32) * (d ** -0.5)
         sim = jnp.where(valid[:, None, :], sim, ATTN_MASK_VALUE)
         attn = jax.nn.softmax(sim, axis=-1).astype(v_cache.dtype)
-        out = jnp.einsum("bhs,bhsd->bhd", attn, v_cache).reshape(b, inner)
+        out = jnp.einsum(
+            "bhs,bhsd->bhd", attn, v_cache,
+            preferred_element_type=jnp.float32,
+        ).astype(v_cache.dtype).reshape(b, inner)
         out = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
                      policy=self.policy, name="to_out")(out)
         return out, new_prev, k_cache, v_cache
@@ -172,7 +175,8 @@ class SGUDecode(nn.Module):
         w_rows = weights.astype(jnp.float32)[pos][:, :n_cache]  # (B, n_cache)
         causal = (jnp.arange(n_cache)[None, :] <= pos[:, None])
         w_rows = w_rows * causal.astype(jnp.float32)
-        mixed = jnp.einsum("bnd,bn->bd", gate_cache.astype(jnp.float32), w_rows)
+        mixed = jnp.einsum("bnd,bn->bd", gate_cache.astype(jnp.float32),
+                           w_rows, preferred_element_type=jnp.float32)
         bias_m = biases.astype(jnp.float32)[pos]  # (B, 1)
         mixed = (mixed + bias_m).astype(x.dtype)
 

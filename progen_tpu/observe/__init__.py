@@ -4,11 +4,13 @@ from progen_tpu.observe.flops import (
     model_flops_per_token,
     peak_flops_per_chip,
 )
+from progen_tpu.observe.gitinfo import git_sha
 from progen_tpu.observe.meter import ThroughputMeter, profile_trace
 from progen_tpu.observe.tracker import Tracker
 
 __all__ = [
     "PEAK_BF16_TFLOPS",
+    "git_sha",
     "mfu",
     "model_flops_per_token",
     "peak_flops_per_chip",

@@ -80,5 +80,7 @@ def local_attention(q, k, v, *, window_size: int, scale: float | None = None):
     mask = window_mask(wsz)
     sim = jnp.where(mask, sim, ATTN_MASK_VALUE)
     attn = jax.nn.softmax(sim, axis=-1).astype(vw.dtype)
-    out = jnp.einsum("bhwij,bhwjd->bhwid", attn, vw)
+    out = jnp.einsum(
+        "bhwij,bhwjd->bhwid", attn, vw, preferred_element_type=jnp.float32
+    ).astype(vw.dtype)
     return out.reshape(b, h, n, d)

@@ -590,15 +590,17 @@ class Trainer:
                         or global_step % cfg.sample_every == 0
                     )
                     if global_step % cfg.log_every == 0:
-                        # float() blocks until the step chain is executed —
-                        # the only trustworthy sync point, so the meter
-                        # ticks HERE with the tokens since the last sync
-                        last_loss = float(metrics["loss"])
+                        # one batched transfer blocks until the step chain
+                        # is executed — the only trustworthy sync point, so
+                        # the meter ticks HERE with the tokens since the
+                        # last sync (one device_get, not one per metric)
+                        host_metrics = jax.device_get(metrics)  # graftcheck: disable=host-sync
+                        last_loss = float(host_metrics["loss"])
                         self.meter.tick(pending_tokens)
                         pending_tokens = 0
                         log = {
                             "loss": last_loss,
-                            "grad_norm": float(metrics["grad_norm"]),
+                            "grad_norm": float(host_metrics["grad_norm"]),
                             # the update that produced step N was scaled with
                             # the schedule read at count N-1 (optax reads the
                             # count before incrementing)
@@ -620,7 +622,9 @@ class Trainer:
                         # and tick BEFORE the hooks so their wall time is
                         # never rated against these steps' tokens (and the
                         # hook's own blocking never absorbs them)
-                        float(metrics["grad_norm"])
+                        # a pure barrier: no value is needed, so don't pay
+                        # for a transfer on top of the wait
+                        jax.block_until_ready(metrics["grad_norm"])  # graftcheck: disable=host-sync
                         self.meter.tick(pending_tokens)
                         pending_tokens = 0
 
@@ -632,7 +636,7 @@ class Trainer:
                     if global_step % cfg.validate_every == 0:
                         vbatch = self._to_device(next(valid_it))
                         vmetrics = self.fns.eval_step(state, vbatch)
-                        vloss = float(vmetrics["loss"])
+                        vloss = float(jax.device_get(vmetrics["loss"]))  # graftcheck: disable=host-sync
                         self.tracker.log({"valid_loss": vloss}, global_step)
                         if process_index == 0:
                             print(f"valid_loss: {vloss:.4f}")
@@ -725,8 +729,11 @@ class Trainer:
                 )
                 np_batch = np.concatenate([np_batch, pad])
             metrics = self.fns.eval_step(state, self._to_device(np_batch))
-            per_row = np.asarray(metrics["per_row_loss"])
-            real = np.asarray(metrics["real_rows"])
+            # one transfer for both reductions instead of two np.asarray
+            # syncs plus two scalar pulls
+            host = jax.device_get(metrics)  # graftcheck: disable=host-sync
+            per_row = np.asarray(host["per_row_loss"])
+            real = np.asarray(host["real_rows"])
             loss_sum += float((per_row * real).sum())
             rows += int(real.sum())
         return loss_sum / rows if rows else None

@@ -1,0 +1,853 @@
+"""graftcheck unit tests: one true-positive and one true-negative per rule,
+suppression + baseline mechanics, JSON output schema, CLI exit codes, and
+the repo-wide zero-findings gate that makes the analyzer a tier-1 check."""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from progen_tpu import analysis
+from progen_tpu.analysis import engine
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+analysis.load_rules()
+
+
+def check(source, path="progen_tpu/some/module.py", rules=None):
+    return engine.check_source(textwrap.dedent(source), path=path, rules=rules)
+
+
+def rule_names(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+
+
+def test_trace_safety_flags_print_in_jitted():
+    findings = check(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("inside trace")
+            return x * 2
+        """,
+        rules=["trace-safety"],
+    )
+    assert rule_names(findings) == ["trace-safety"]
+    assert "jax.debug.print" in findings[0].message
+
+
+def test_trace_safety_flags_time_reachable_from_scan():
+    findings = check(
+        """
+        import time
+        from jax import lax
+
+        def body(carry, x):
+            t = time.perf_counter()
+            return carry + x + t, x
+
+        def run(xs):
+            return lax.scan(body, 0.0, xs)
+        """,
+        rules=["trace-safety"],
+    )
+    assert rule_names(findings) == ["trace-safety"]
+
+
+def test_trace_safety_flags_np_random_via_callee():
+    # reachability must propagate through same-module calls
+    findings = check(
+        """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return x + np.random.rand()
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+        """,
+        rules=["trace-safety"],
+    )
+    assert rule_names(findings) == ["trace-safety"]
+
+
+def test_trace_safety_ignores_host_driver_code():
+    findings = check(
+        """
+        import time
+
+        def train_loop(n):
+            t0 = time.perf_counter()
+            for i in range(n):
+                print("host-side logging is fine", i)
+            return time.perf_counter() - t0
+        """,
+        rules=["trace-safety"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# rng-reuse / rng-split-dropped
+# ---------------------------------------------------------------------------
+
+
+def test_rng_reuse_flags_double_consumption():
+    findings = check(
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+        """,
+        rules=["rng-reuse"],
+    )
+    assert rule_names(findings) == ["rng-reuse"]
+    assert "'key'" in findings[0].message
+
+
+def test_rng_reuse_flags_loop_without_resplit():
+    findings = check(
+        """
+        import jax
+
+        def sample(key, n):
+            out = []
+            for _ in range(n):
+                out.append(jax.random.normal(key, (4,)))
+            return out
+        """,
+        rules=["rng-reuse"],
+    )
+    assert rule_names(findings) == ["rng-reuse"]
+
+
+def test_rng_reuse_accepts_split_discipline():
+    findings = check(
+        """
+        import jax
+
+        def sample(key, n):
+            out = []
+            for _ in range(n):
+                key, sub = jax.random.split(key)
+                out.append(jax.random.normal(sub, (4,)))
+            a, b = jax.random.split(key)
+            return out, jax.random.uniform(a), jax.random.uniform(b)
+        """,
+        rules=["rng-reuse"],
+    )
+    assert findings == []
+
+
+def test_rng_reuse_accepts_branches():
+    # either branch runs, not both: one consumption each is fine
+    findings = check(
+        """
+        import jax
+
+        def sample(key, greedy):
+            if greedy:
+                return jax.random.categorical(key, None)
+            else:
+                return jax.random.normal(key, (4,))
+        """,
+        rules=["rng-reuse"],
+    )
+    assert findings == []
+
+
+def test_rng_split_dropped_flags_bare_statement():
+    findings = check(
+        """
+        import jax
+
+        def warmup(key):
+            jax.random.split(key)
+            return key
+        """,
+        rules=["rng-split-dropped"],
+    )
+    assert rule_names(findings) == ["rng-split-dropped"]
+
+
+def test_rng_split_dropped_flags_underscore_assignment():
+    findings = check(
+        """
+        import jax
+
+        def warmup(key):
+            _ = jax.random.split(key)
+            return key
+        """,
+        rules=["rng-split-dropped"],
+    )
+    assert rule_names(findings) == ["rng-split-dropped"]
+
+
+def test_rng_split_used_is_clean():
+    findings = check(
+        """
+        import jax
+
+        def warmup(key):
+            key, sub = jax.random.split(key)
+            return key, sub
+        """,
+        rules=["rng-split-dropped"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# dtype-pet / dtype-f32-literal
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_pet_flags_bare_einsum_in_ops():
+    findings = check(
+        """
+        import jax.numpy as jnp
+
+        def attend(q, k):
+            return jnp.einsum("bhid,bhjd->bhij", q, k)
+        """,
+        path="progen_tpu/ops/attention.py",
+        rules=["dtype-pet"],
+    )
+    assert rule_names(findings) == ["dtype-pet"]
+    assert "preferred_element_type" in findings[0].message
+
+
+def test_dtype_pet_accepts_pinned_einsum():
+    findings = check(
+        """
+        import jax.numpy as jnp
+
+        def attend(q, k):
+            return jnp.einsum("bhid,bhjd->bhij", q, k,
+                              preferred_element_type=jnp.float32)
+        """,
+        path="progen_tpu/ops/attention.py",
+        rules=["dtype-pet"],
+    )
+    assert findings == []
+
+
+def test_dtype_pet_scoped_to_numeric_core():
+    # the same bare einsum outside ops/ and decode/ is not this rule's business
+    findings = check(
+        """
+        import jax.numpy as jnp
+
+        def attend(q, k):
+            return jnp.einsum("bhid,bhjd->bhij", q, k)
+        """,
+        path="progen_tpu/observe/flops.py",
+        rules=["dtype-pet"],
+    )
+    assert findings == []
+
+
+def test_dtype_literal_flags_inexact_bf16_mix():
+    findings = check(
+        """
+        import jax.numpy as jnp
+
+        def norm(x):
+            return x.astype(jnp.bfloat16) + 1e-6
+        """,
+        rules=["dtype-f32-literal"],
+    )
+    assert rule_names(findings) == ["dtype-f32-literal"]
+
+
+def test_dtype_literal_accepts_exact_and_f32():
+    findings = check(
+        """
+        import jax.numpy as jnp
+
+        def scale(x):
+            a = x.astype(jnp.bfloat16) * 0.5
+            b = x.astype(jnp.float32) * 0.1
+            return a, b
+        """,
+        rules=["dtype-f32-literal"],
+    )
+    assert findings == []
+
+
+def test_bf16_exact_helper():
+    from progen_tpu.analysis.rules_dtype import bf16_exact
+
+    assert bf16_exact(0.5) and bf16_exact(2.0) and bf16_exact(-1.0)
+    assert not bf16_exact(0.1) and not bf16_exact(1e-6)
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_axis_flags_unknown_axis():
+    findings = check(
+        """
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("model", None)
+        """,
+        rules=["mesh-axis"],
+    )
+    assert rule_names(findings) == ["mesh-axis"]
+    assert "'model'" in findings[0].message
+
+
+def test_mesh_axis_accepts_declared_axes_and_tuples():
+    findings = check(
+        """
+        from jax.sharding import PartitionSpec as P
+
+        A = P(("data", "fsdp"), None)
+        B = P(None, "seq", "tensor")
+        """,
+        rules=["mesh-axis"],
+    )
+    assert findings == []
+
+
+def test_mesh_axis_vocabulary_comes_from_mesh_py():
+    # the live repo declares MESH_AXES in core/mesh.py; discovery must find it
+    ctx = engine.build_context(REPO_ROOT)
+    assert ctx.mesh_axes == frozenset({"data", "fsdp", "tensor", "seq"})
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+_TRAINER_PATH = "progen_tpu/train/trainer.py"
+
+
+def test_host_sync_flags_float_in_run_loop():
+    findings = check(
+        """
+        class Trainer:
+            def _run_loop(self, metrics):
+                loss = float(metrics["loss"])
+                return loss
+        """,
+        path=_TRAINER_PATH,
+        rules=["host-sync"],
+    )
+    assert rule_names(findings) == ["host-sync"]
+    assert "device sync" in findings[0].message
+
+
+def test_host_sync_flags_asarray_in_engine_step():
+    findings = check(
+        """
+        import numpy as np
+
+        class ServingEngine:
+            def step(self):
+                done = np.asarray(self.state["done"])
+                return done
+        """,
+        path="progen_tpu/decode/engine.py",
+        rules=["host-sync"],
+    )
+    assert rule_names(findings) == ["host-sync"]
+
+
+def test_host_sync_accepts_device_get_consolidation():
+    # the sanctioned idiom: one explicit, suppressed device_get; everything
+    # derived from it is host-side and free to float()/np.asarray()
+    findings = check(
+        """
+        import jax
+        import numpy as np
+
+        class Trainer:
+            def _run_loop(self, metrics):
+                host = jax.device_get(metrics)  # graftcheck: disable=host-sync
+                loss = float(host["loss"])
+                grad = np.asarray(host["grad_norm"])
+                return loss, grad
+        """,
+        path=_TRAINER_PATH,
+        rules=["host-sync"],
+    )
+    assert findings == []
+
+
+def test_host_sync_ignores_functions_outside_zones():
+    findings = check(
+        """
+        class Trainer:
+            def _checkpoint(self, state):
+                return float(state.step)
+        """,
+        path=_TRAINER_PATH,
+        rules=["host-sync"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+
+def test_donation_flags_read_after_donating_call():
+    findings = check(
+        """
+        import jax
+
+        def make(step_impl):
+            step = jax.jit(step_impl, donate_argnums=(0,))
+
+            def run(state, batch):
+                new_state = step(state, batch)
+                stale = state.params
+                return new_state, stale
+
+            return run
+        """,
+        rules=["donation"],
+    )
+    assert rule_names(findings) == ["donation"]
+    assert "'state'" in findings[0].message
+
+
+def test_donation_accepts_rebinding():
+    findings = check(
+        """
+        import jax
+
+        def make(step_impl):
+            step = jax.jit(step_impl, donate_argnums=(0,))
+
+            def run(state, batch):
+                state = step(state, batch)
+                return state.params
+
+            return run
+        """,
+        rules=["donation"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# recompile
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_flags_config_arg_without_static():
+    findings = check(
+        """
+        import jax
+
+        def step_impl(params, config):
+            return params
+
+        step = jax.jit(step_impl)
+        """,
+        rules=["recompile"],
+    )
+    assert rule_names(findings) == ["recompile"]
+    assert "'config'" in findings[0].message
+
+
+def test_recompile_accepts_static_argnames():
+    findings = check(
+        """
+        import jax
+
+        def step_impl(params, config):
+            return params
+
+        step = jax.jit(step_impl, static_argnames=("config",))
+        """,
+        rules=["recompile"],
+    )
+    assert findings == []
+
+
+def test_recompile_flags_string_leaf_literal_at_call_site():
+    findings = check(
+        """
+        import jax
+
+        def f_impl(x, opts):
+            return x
+
+        f = jax.jit(f_impl)
+
+        def run(x):
+            return f(x, {"mode": "fast"})
+        """,
+        rules=["recompile"],
+    )
+    assert rule_names(findings) == ["recompile"]
+
+
+def test_recompile_accepts_array_pytree_literals():
+    # dicts of arrays are legitimate traced pytrees (batches!)
+    findings = check(
+        """
+        import jax
+
+        def f_impl(x, batch):
+            return x
+
+        f = jax.jit(f_impl)
+
+        def run(x, tokens, mask):
+            return f(x, {"tokens": tokens, "mask": mask})
+        """,
+        rules=["recompile"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# pallas-indexmap / pallas-ref-write
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_indexmap_flags_traced_closure():
+    findings = check(
+        """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch(x, idx):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((128,), lambda i: (idx[i], 0))],
+            )(x)
+        """,
+        rules=["pallas-indexmap"],
+    )
+    assert rule_names(findings) == ["pallas-indexmap"]
+    assert "'idx'" in findings[0].message
+
+
+def test_pallas_indexmap_accepts_shape_derived_ints():
+    findings = check(
+        """
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch(x, block: int):
+            n = x.shape[0]
+            nb = n // block
+            return pl.pallas_call(
+                kernel,
+                grid=(nb,),
+                in_specs=[pl.BlockSpec((block,), lambda i: (i % nb, 0))],
+            )(x)
+        """,
+        rules=["pallas-indexmap"],
+    )
+    assert findings == []
+
+
+def test_pallas_indexmap_accepts_helper_returned_ints():
+    # one level of interprocedural staticness: tuple-unpack from a module
+    # helper whose return elements are shape-derived ints
+    findings = check(
+        """
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def _prep(x, block: int):
+            n = x.shape[0]
+            nbr = -(-n // block)
+            return x, nbr
+
+        def launch(x, block: int):
+            x, nbr = _prep(x, block)
+            return pl.pallas_call(
+                kernel,
+                grid=(nbr,),
+                in_specs=[pl.BlockSpec((block,), lambda i: (i % nbr, 0))],
+            )(x)
+        """,
+        rules=["pallas-indexmap"],
+    )
+    assert findings == []
+
+
+def test_pallas_ref_write_flags_plain_store_in_loop():
+    findings = check(
+        """
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            for i in range(4):
+                o_ref[...] = x_ref[i]
+
+        def launch(x):
+            return pl.pallas_call(kernel)(x)
+        """,
+        rules=["pallas-ref-write"],
+    )
+    assert rule_names(findings) == ["pallas-ref-write"]
+    assert "'o_ref'" in findings[0].message
+
+
+def test_pallas_ref_write_accepts_accumulation():
+    findings = check(
+        """
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref, acc_ref):
+            for i in range(4):
+                acc_ref[...] += x_ref[i]
+            o_ref[...] = acc_ref[...]
+
+        def launch(x):
+            return pl.pallas_call(kernel)(x)
+        """,
+        rules=["pallas-ref-write"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_BARE_EINSUM = """
+import jax.numpy as jnp
+
+def attend(q, k):
+    return jnp.einsum("bhid,bhjd->bhij", q, k){comment}
+"""
+
+
+def test_suppression_on_finding_line():
+    src = _BARE_EINSUM.format(comment="  # graftcheck: disable=dtype-pet")
+    assert check(src, path="progen_tpu/ops/x.py", rules=["dtype-pet"]) == []
+
+
+def test_suppression_on_preceding_comment_line():
+    src = textwrap.dedent(
+        """
+        import jax.numpy as jnp
+
+        def attend(q, k):
+            # graftcheck: disable=dtype-pet
+            return jnp.einsum("bhid,bhjd->bhij", q, k)
+        """
+    )
+    assert check(src, path="progen_tpu/ops/x.py", rules=["dtype-pet"]) == []
+
+
+def test_suppression_file_wide():
+    src = textwrap.dedent(
+        """
+        # graftcheck: disable-file=dtype-pet
+        import jax.numpy as jnp
+
+        def attend(q, k):
+            return jnp.einsum("bhid,bhjd->bhij", q, k)
+        """
+    )
+    assert check(src, path="progen_tpu/ops/x.py", rules=["dtype-pet"]) == []
+
+
+def test_suppression_of_other_rule_does_not_hide():
+    src = _BARE_EINSUM.format(comment="  # graftcheck: disable=host-sync")
+    findings = check(src, path="progen_tpu/ops/x.py", rules=["dtype-pet"])
+    assert rule_names(findings) == ["dtype-pet"]
+
+
+def test_trailing_comment_on_previous_code_line_does_not_leak():
+    src = textwrap.dedent(
+        """
+        import jax.numpy as jnp
+
+        def attend(q, k):
+            q = q * 2  # graftcheck: disable=dtype-pet
+            return jnp.einsum("bhid,bhjd->bhij", q, k)
+        """
+    )
+    findings = check(src, path="progen_tpu/ops/x.py", rules=["dtype-pet"])
+    assert rule_names(findings) == ["dtype-pet"]
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip_and_matching(tmp_path):
+    findings = check(
+        _BARE_EINSUM.format(comment=""),
+        path="progen_tpu/ops/x.py",
+        rules=["dtype-pet"],
+    )
+    assert len(findings) == 1
+    baseline_file = tmp_path / "baseline.json"
+    engine.save_baseline(baseline_file, findings)
+    baseline = engine.load_baseline(baseline_file)
+
+    new, old = engine.apply_baseline(findings, baseline)
+    assert new == [] and len(old) == 1
+
+    # baseline keys ignore line numbers: shifting the finding down a few
+    # lines (unrelated edits above it) must not invalidate the entry
+    shifted = check(
+        "\n\n\n" + _BARE_EINSUM.format(comment=""),
+        path="progen_tpu/ops/x.py",
+        rules=["dtype-pet"],
+    )
+    new, old = engine.apply_baseline(shifted, baseline)
+    assert new == [] and len(old) == 1
+
+    # ...but a different rule/path/message is a new finding
+    other = check(
+        _BARE_EINSUM.format(comment=""),
+        path="progen_tpu/decode/y.py",
+        rules=["dtype-pet"],
+    )
+    new, old = engine.apply_baseline(other, baseline)
+    assert len(new) == 1 and old == []
+
+
+# ---------------------------------------------------------------------------
+# output formats
+# ---------------------------------------------------------------------------
+
+
+def test_json_output_schema():
+    findings = check(
+        _BARE_EINSUM.format(comment=""),
+        path="progen_tpu/ops/x.py",
+        rules=["dtype-pet"],
+    )
+    payload = json.loads(engine.format_json(findings, baselined=2))
+    assert payload["version"] == 1
+    assert payload["count"] == 1
+    assert payload["baselined"] == 2
+    (f,) = payload["findings"]
+    assert set(f) == {"rule", "path", "line", "col", "message"}
+    assert f["rule"] == "dtype-pet"
+    assert f["path"] == "progen_tpu/ops/x.py"
+    assert isinstance(f["line"], int) and isinstance(f["col"], int)
+
+
+def test_human_output_format():
+    findings = check(
+        _BARE_EINSUM.format(comment=""),
+        path="progen_tpu/ops/x.py",
+        rules=["dtype-pet"],
+    )
+    text = engine.format_human(findings)
+    assert "progen_tpu/ops/x.py:" in text
+    assert "[dtype-pet]" in text
+    assert text.endswith("1 finding(s)")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "graftcheck.py"), *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+
+
+def test_cli_list_rules_covers_all_eight_hazard_classes():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    listed = set(proc.stdout.split())
+    assert listed >= {
+        "trace-safety",
+        "rng-reuse",
+        "rng-split-dropped",
+        "dtype-pet",
+        "dtype-f32-literal",
+        "mesh-axis",
+        "host-sync",
+        "donation",
+        "recompile",
+        "pallas-indexmap",
+        "pallas-ref-write",
+    }
+
+
+def test_cli_exit_codes(tmp_path):
+    dirty = tmp_path / "dirty"
+    dirty.mkdir()
+    (dirty / "ops").mkdir()
+    (dirty / "ops" / "bad.py").write_text(
+        "import jax.numpy as jnp\n\n"
+        "def f(q, k):\n"
+        "    return jnp.einsum('id,jd->ij', q, k)\n"
+    )
+    proc = _run_cli(str(dirty), "--no-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "[dtype-pet]" in proc.stdout
+
+    proc = _run_cli(str(tmp_path / "nope.py"))
+    assert proc.returncode == 2
+
+    proc = _run_cli("--rules", "not-a-rule", "progen_tpu")
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the repo itself must be clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_wide_zero_findings_gate():
+    targets = [
+        REPO_ROOT / "progen_tpu",
+        REPO_ROOT / "tools",
+        REPO_ROOT / "train.py",
+        REPO_ROOT / "sample.py",
+        REPO_ROOT / "bench.py",
+    ]
+    findings = analysis.run(targets, root=REPO_ROOT)
+    baseline_path = REPO_ROOT / "tools" / "graftcheck_baseline.json"
+    baseline = (
+        engine.load_baseline(baseline_path) if baseline_path.is_file() else set()
+    )
+    new, _ = engine.apply_baseline(findings, baseline)
+    assert not new, "\n" + engine.format_human(new)

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# One-command local gate: static analysis + bytecode compile + quick tests.
+# Usable as a pre-push hook or CI entrypoint:
+#   ln -s ../../tools/check.sh .git/hooks/pre-push
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+
+echo "== graftcheck =="
+python tools/graftcheck.py progen_tpu tools train.py sample.py bench.py
+
+echo "== compileall =="
+python -m compileall -q progen_tpu tools benchmarks tests train.py sample.py bench.py
+
+echo "== quick tier-1 subset =="
+# the fast, single-host slice of tier-1: analyzer suite + core numerics.
+# The full tier-1 sweep (ROADMAP.md) still runs in CI.
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_graftcheck.py tests/test_ops.py tests/test_loss.py \
+    tests/test_decode.py tests/test_observe.py \
+    -q -m 'not slow' -p no:cacheprovider
+
+echo "== all checks passed =="

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""graftcheck CLI: JAX/TPU-aware static analysis for this repo.
+
+Usage:
+
+    python tools/graftcheck.py progen_tpu tools train.py sample.py bench.py
+    python tools/graftcheck.py --json progen_tpu
+    python tools/graftcheck.py --rules host-sync,dtype-pet progen_tpu
+    python tools/graftcheck.py --list-rules
+    python tools/graftcheck.py --update-baseline progen_tpu ...
+
+Exit codes: 0 clean (or all findings baselined), 1 non-baselined findings,
+2 usage/internal error — suitable for CI.
+
+The analyzer is pure stdlib.  ``progen_tpu/__init__`` imports jax, which
+this CLI must not pay for, so when the package is not already imported we
+register a namespace stub whose ``__path__`` points at the package
+directory — ``progen_tpu.analysis`` then loads without executing the heavy
+package ``__init__``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import types
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "graftcheck_baseline.json"
+
+
+def _import_analysis():
+    if str(REPO_ROOT) not in sys.path:
+        sys.path.insert(0, str(REPO_ROOT))
+    if "progen_tpu" not in sys.modules:
+        stub = types.ModuleType("progen_tpu")
+        stub.__path__ = [str(REPO_ROOT / "progen_tpu")]
+        sys.modules["progen_tpu"] = stub
+    from progen_tpu import analysis
+
+    return analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftcheck", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument("--json", action="store_true", help="JSON output")
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated subset of rules to run",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=DEFAULT_BASELINE,
+        help=f"baseline file (default: {DEFAULT_BASELINE.name})",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report baselined findings too",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write all current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    analysis = _import_analysis()
+
+    if args.list_rules:
+        for name in sorted(analysis.load_rules()):
+            print(name)
+        return 0
+
+    if not args.paths:
+        parser.error("no paths given (try: progen_tpu tools train.py)")
+
+    rules = args.rules.split(",") if args.rules else None
+    if rules:
+        unknown = set(rules) - set(analysis.load_rules())
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = [Path(p) for p in args.paths]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"no such path: {', '.join(map(str, missing))}", file=sys.stderr)
+        return 2
+
+    findings = analysis.run(paths, root=REPO_ROOT, rules=rules)
+
+    if args.update_baseline:
+        analysis.save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = set()
+    if not args.no_baseline and args.baseline.is_file():
+        baseline = analysis.load_baseline(args.baseline)
+    new, baselined = analysis.apply_baseline(findings, baseline)
+
+    if args.json:
+        print(analysis.format_json(new, baselined=len(baselined)))
+    else:
+        print(analysis.format_human(new, baselined=len(baselined)))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
