@@ -27,6 +27,13 @@ loss+gradients WITHOUT optimizer state, the only way to run the 1.2B+
 configs on a single 16GB v5e chip (f32 Adam moments alone exceed HBM;
 the north-star v4-32 setting shards them over fsdp).  The metric string
 labels the mode so the numbers cannot be confused.
+PROGEN_BENCH_SUPERSTEP (default 1) — fuse K optimizer steps per dispatch
+via train_multi_step (train mode only); benchmarks/bench_superstep.py
+sweeps K and records the steps/s ladder.
+
+Any failure INSIDE run_one (backend init at first device use, OOM,
+compile error) emits the same structured JSON error record as a failed
+startup probe and exits 0 — the driver always gets parseable output.
 
 PROGEN_BENCH_CONFIGS=small,base,large runs the whole ladder — one JSON
 line per config, each with the per-config defaults from LADDER (the
@@ -75,7 +82,7 @@ LADDER = {
 
 def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
             sgu_impl: str, mode: str, remat: bool,
-            remat_policy: str) -> dict:
+            remat_policy: str, superstep: int = 1) -> dict:
     from progen_tpu.core.mesh import MeshConfig, make_mesh
     from progen_tpu.core.precision import make_policy
     from progen_tpu.models import ProGen
@@ -104,6 +111,12 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
         for _ in range(4)
     ]
 
+    superstep = max(1, int(superstep))
+    if superstep > 1 and mode != "train":
+        raise SystemExit(
+            f"PROGEN_BENCH_SUPERSTEP={superstep} needs "
+            f"PROGEN_BENCH_MODE=train (got {mode!r})")
+
     if mode == "train":
         fns = make_train_functions(
             model, make_optimizer(2e-4), sample,
@@ -111,7 +124,17 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
         )
         state = fns.init_state(jax.random.key(0))
         num_params = sum(x.size for x in jax.tree.leaves(state.params))
-        run = lambda s, b: fns.train_step(s, b)
+        if superstep > 1:
+            # one (K, 1, B, L) superbatch, re-transferred per dispatch:
+            # train_multi_step donates its superbatch buffer
+            host_super = np.stack([
+                synthetic_uniref_batch(rng, batch, cfg.seq_len)
+                for _ in range(superstep)
+            ])[:, None]
+            run = lambda s, b: fns.train_multi_step(
+                s, jnp.asarray(host_super))
+        else:
+            run = lambda s, b: fns.train_step(s, b)
     elif mode == "fwdbwd":
         if n_chips > 1:
             # fwdbwd_step is jitted without mesh shardings; dividing by
@@ -148,15 +171,24 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
 
     # host transfer of grad_norm: the only reliable full sync on tunneled
     # backends where block_until_ready can return early; grad_norm (not
-    # loss) so the backward is a live output in both modes
+    # loss) so the backward is a live output in both modes.  Fused
+    # dispatches return (K, accum)-stacked metrics — sync the last.
+    def sync(m):
+        float(np.asarray(m["grad_norm"]).ravel()[-1])
+
+    # dispatch count: each fused dispatch covers `superstep` optimizer
+    # steps, so a K-sweep at fixed PROGEN_BENCH_STEPS compares equal work
+    dispatches = max(1, steps // superstep)
+    steps = dispatches * superstep
+
     for i in range(warmup):
         state, metrics = run(state, batches[i % len(batches)])
-    float(metrics["grad_norm"])
+    sync(metrics)
 
     t0 = time.perf_counter()
-    for i in range(steps):
+    for i in range(dispatches):
         state, metrics = run(state, batches[i % len(batches)])
-    float(metrics["grad_norm"])
+    sync(metrics)
     dt = time.perf_counter() - t0
 
     tokens = steps * batch * cfg.seq_len
@@ -176,11 +208,14 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
             f" throughput, ProGen-{config_name} "
             f"(seq_len {cfg.seq_len}, batch {batch}, bf16, "
             f"{attn_impl} attn, {sgu_impl} sgu"
-            f"{(', remat:' + remat_policy) if remat else ''}, "
+            f"{(', remat:' + remat_policy) if remat else ''}"
+            f"{f', superstep {superstep}' if superstep > 1 else ''}, "
             f"{n_chips} chip(s))"
         ),
         "value": round(tps_chip, 1),
         "unit": "tokens/sec/chip",
+        "steps_per_sec": round(steps / dt, 3),
+        "superstep": superstep,
         # vs_baseline compares TRAIN steps to the train-step north
         # star; a lighter fwd+bwd-only run must not claim the ratio
         "vs_baseline": (
@@ -192,6 +227,36 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
         "sgu_impl": sgu_impl,
         "git_sha": git_sha(),
     }
+
+
+def _emit_error_record(e: BaseException) -> None:
+    """One parseable JSON error line (stdout, rc stays 0) with a platform
+    stamp — the driver ingests this instead of a raw traceback."""
+    import platform
+
+    print(json.dumps({
+        "error": f"{type(e).__name__}: {e}",
+        "metric": None,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "jax_version": jax.__version__,
+        "python": platform.python_version(),
+        "git_sha": git_sha(),
+    }), flush=True)
+
+
+def _run_one_guarded(config_name: str, **kwargs) -> bool:
+    """Run one bench config, printing its JSON line; any failure inside
+    (backend init at first device use — the startup probe only guards a
+    clean ``jax.devices()`` — OOM, compile error) becomes the structured
+    error record instead of a traceback + rc 1.  SystemExit (intentional
+    usage errors with their own message) still propagates."""
+    try:
+        record = run_one(config_name, **kwargs)
+    except Exception as e:
+        _emit_error_record(e)
+        return False
+    print(json.dumps(record), flush=True)
+    return True
 
 
 def _probe_backend() -> bool:
@@ -238,16 +303,7 @@ def _probe_backend() -> bool:
         retry_call(probe, policy=policy, label="backend-init")
         return True
     except Exception as e:  # RetryError or fatal init error: report, don't raise
-        import platform
-
-        print(json.dumps({
-            "error": f"{type(e).__name__}: {e}",
-            "metric": None,
-            "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
-            "jax_version": jax.__version__,
-            "python": platform.python_version(),
-            "git_sha": git_sha(),
-        }), flush=True)
+        _emit_error_record(e)
         return False
 
 
@@ -257,6 +313,7 @@ def main() -> None:
     steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))
     attn_impl = os.environ.get("PROGEN_BENCH_ATTN", "pallas")
     sgu_impl = os.environ.get("PROGEN_BENCH_SGU", "pallas")
+    superstep = int(os.environ.get("PROGEN_BENCH_SUPERSTEP", "1"))
 
     ladder = os.environ.get("PROGEN_BENCH_CONFIGS")
     if ladder:
@@ -272,16 +329,17 @@ def main() -> None:
                 # full train state exceeds one chip; on a real slice the
                 # sharded train mode is the meaningful measurement
                 spec.update(mode="train")
-            print(json.dumps(run_one(
+            _run_one_guarded(
                 name, batch=spec["batch"], steps=steps,
                 attn_impl=attn_impl, sgu_impl=sgu_impl, mode=spec["mode"],
                 remat=spec["remat"], remat_policy=spec["remat_policy"],
-            )), flush=True)
+                superstep=superstep if spec["mode"] == "train" else 1,
+            )
         return
 
     config_name = os.environ.get("PROGEN_BENCH_CONFIG", "small")
     remat_default = config_name in ("base", "large", "xl")
-    print(json.dumps(run_one(
+    _run_one_guarded(
         config_name,
         batch=int(os.environ.get("PROGEN_BENCH_BATCH", "8")),
         steps=steps,
@@ -291,7 +349,8 @@ def main() -> None:
         remat=os.environ.get("PROGEN_BENCH_REMAT",
                              "1" if remat_default else "0") == "1",
         remat_policy=os.environ.get("PROGEN_BENCH_REMAT_POLICY", "full"),
-    )))
+        superstep=superstep,
+    )
 
 
 if __name__ == "__main__":

@@ -39,7 +39,7 @@ class Zone:
 HOT_ZONES: tuple[Zone, ...] = (
     Zone(
         r"train/trainer\.py$",
-        r"Trainer\.(_run_loop|evaluate)$",
+        r"Trainer\.(_run_loop|_run_loop_superstep|evaluate)$",
         frozenset({"meter", "tracker", "config", "model_config", "store",
                    "_recorder", "lr_schedule"}),
     ),
@@ -49,7 +49,8 @@ HOT_ZONES: tuple[Zone, ...] = (
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run"}),
     ),
-    Zone(r"train/step\.py$", r".*\.(train_step|eval_step)$"),
+    Zone(r"train/step\.py$",
+         r".*\.(train_step|_train_step_body|train_multi_step|eval_step)$"),
 )
 
 _SYNC_CALLS = frozenset(

@@ -25,6 +25,8 @@ import queue
 import threading
 from typing import Any, Callable, Iterator
 
+import numpy as np
+
 
 class _End:
     pass
@@ -119,3 +121,59 @@ class DevicePrefetcher:
                 RuntimeWarning,
                 stacklevel=2,
             )
+
+
+class SuperbatchStager:
+    """Stage ``(k, accum, B, L)`` superbatches for the fused multi-step
+    train loop (``TrainFunctions.train_multi_step``).
+
+    A background thread keeps up to ``depth`` supersteps' worth of host
+    micro-batches pulled ahead (reusing :class:`DevicePrefetcher` with an
+    identity transform as the host-side buffer); :meth:`get` stacks the
+    next ``k * accum`` of them into one contiguous array and hands it to
+    ``to_device`` — a JAX transfer is asynchronous, so the copy streams to
+    HBM while the PREVIOUS superstep is still executing, and the returned
+    array is fresh every call (safe for the step's buffer donation).
+
+    ``k`` may vary per call (the trainer shrinks the final superstep
+    before a hook boundary) up to the ``k_max`` the stager was sized for.
+    """
+
+    def __init__(
+        self,
+        iterator: Iterator[Any],
+        to_device: Callable[[Any], Any],
+        accum: int,
+        k_max: int,
+        depth: int = 2,
+    ):
+        if accum < 1:
+            raise ValueError(f"accum must be >= 1, got {accum}")
+        if k_max < 1:
+            raise ValueError(f"k_max must be >= 1, got {k_max}")
+        self._accum = accum
+        self._k_max = k_max
+        self._to_device = to_device
+        self._host = DevicePrefetcher(
+            iterator,
+            lambda batch: batch,  # host-side buffering only
+            depth=max(1, depth) * k_max * accum,
+        )
+
+    def get(self, k: int):
+        """The next ``k`` optimizer steps' data as one ``(k, accum, B, L)``
+        device array (its transfer may still be in flight — JAX arrays are
+        futures).  Raises ``StopIteration`` when the wrapped iterator
+        cannot supply a full superbatch (the trainer feeds a looping
+        stream, so this only surfaces on finite test iterators)."""
+        if not 1 <= k <= self._k_max:
+            raise ValueError(f"k must be in [1, {self._k_max}], got {k}")
+        need = k * self._accum
+        micro = [next(self._host) for _ in range(need)]
+        stacked = np.stack(micro).reshape(
+            (k, self._accum) + np.shape(micro[0]))
+        return self._to_device(stacked)
+
+    def close(self) -> None:
+        """Stop the host prefetch worker and drop buffered batches."""
+        self._host.close()

@@ -28,15 +28,18 @@ class ThroughputMeter:
     def __init__(self, window: int = 50):
         self._window = window
         self._anchor: float | None = None
-        # (duration, tokens) per sync interval — durations are stored, not
-        # absolute times, so rebase() can cut hook time out of the middle
-        # of the window
-        self._intervals: list[tuple[float, int]] = []
+        # (duration, tokens, steps) per sync interval — durations are
+        # stored, not absolute times, so rebase() can cut hook time out of
+        # the middle of the window
+        self._intervals: list[tuple[float, int, int]] = []
 
-    def tick(self, tokens: int) -> None:
+    def tick(self, tokens: int, steps: int = 0) -> None:
+        """Close the current interval: ``tokens`` (and optionally ``steps``
+        — optimizer steps, for the superstep loop where one sync covers K
+        of them) processed since the previous tick."""
         now = time.perf_counter()
         if self._anchor is not None:
-            self._intervals.append((now - self._anchor, tokens))
+            self._intervals.append((now - self._anchor, tokens, steps))
             if len(self._intervals) > self._window:
                 self._intervals.pop(0)
         # the first-ever tick only opens the clock: its tokens include
@@ -54,9 +57,21 @@ class ThroughputMeter:
     def tokens_per_sec(self) -> float | None:
         if not self._intervals:
             return None
-        dt = sum(d for d, _ in self._intervals)
-        toks = sum(t for _, t in self._intervals)
+        dt = sum(d for d, _, _ in self._intervals)
+        toks = sum(t for _, t, _ in self._intervals)
         return toks / dt if dt > 0 else None
+
+    @property
+    def steps_per_sec(self) -> float | None:
+        """Optimizer steps/sec over the window; None until a tick has
+        carried a step count (the per-step loop rates tokens only)."""
+        if not self._intervals:
+            return None
+        dt = sum(d for d, _, _ in self._intervals)
+        steps = sum(s for _, _, s in self._intervals)
+        if dt <= 0 or steps == 0:
+            return None
+        return steps / dt
 
     @property
     def tokens_per_sec_per_chip(self) -> float | None:

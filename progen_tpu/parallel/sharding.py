@@ -114,5 +114,14 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(("data", "fsdp"), None))
 
 
+def superbatch_sharding(mesh: Mesh) -> NamedSharding:
+    """Staged superbatch layout ``(K, accum, B, L)``: the scan axes K and
+    accum replicate (every chip walks the same step sequence); the batch
+    dim shards exactly like :func:`batch_sharding` so each scanned slice
+    is already laid out for the step body."""
+    return NamedSharding(mesh, PartitionSpec(None, None, ("data", "fsdp"),
+                                             None))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())

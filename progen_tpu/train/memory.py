@@ -62,6 +62,7 @@ class MemoryPlan:
     num_params: int
     detail: dict
     snapshot_bytes: int = 0
+    superbatch_bytes: int = 0
 
     @property
     def state_bytes(self) -> int:
@@ -73,7 +74,8 @@ class MemoryPlan:
 
     @property
     def total_bytes(self) -> int:
-        return self.state_bytes + self.temp_bytes + self.snapshot_bytes
+        return (self.state_bytes + self.temp_bytes + self.snapshot_bytes
+                + self.superbatch_bytes)
 
     def report(self) -> str:
         rows = [
@@ -84,7 +86,8 @@ class MemoryPlan:
             ("bf16 param casts", self.cast_bytes),
             ("f32 logits + softmax bwd", self.logits_bytes),
             ("background-checkpoint snapshot", self.snapshot_bytes),
-            ("peak = state + max(act, cast) + logits + snapshot",
+            ("staged superbatches (int32)", self.superbatch_bytes),
+            ("peak = state + max(act, cast) + logits + snapshot + stage",
              self.total_bytes),
         ]
         return "\n".join(f"  {name:<48} {b / GiB:7.2f} GiB" for name, b in rows)
@@ -188,12 +191,15 @@ def plan(
     mixed_precision: bool = True,
     grad_accum_every: int = 1,
     checkpoint_snapshot: bool = False,
+    superstep_k: int = 1,
 ) -> MemoryPlan:
     """Predict per-chip HBM for one jitted train step.
 
     ``batch_size`` is the GLOBAL micro-batch fed to ``train_step``;
     ``mesh_shape`` like ``{"data": 1, "fsdp": 8, "tensor": 1, "seq": 1}``
-    (None = single chip).
+    (None = single chip).  ``superstep_k > 1`` adds the fused loop's
+    staged ``(K, accum, B, L)`` superbatch buffers — two live at steady
+    state, the one being scanned plus the next one in async transfer.
     """
     mesh_shape = mesh_shape or {}
     data = mesh_shape.get("data", 1)
@@ -247,6 +253,16 @@ def plan(
     # the full state while the save's device->host fetch runs
     snapshot_b = (params_b + moments_b + accum_b) if checkpoint_snapshot else 0
 
+    # fused superstep staging: the (K, accum, B, L+1) int32 superbatch
+    # being scanned (donated, but alive until the scan consumes it) plus
+    # the next one already streaming in; batch dim sharded like the batch
+    superbatch_b = 0
+    if superstep_k > 1:
+        rows = batch_size // (data * max(fsdp, 1))
+        superbatch_b = (2 * superstep_k * max(1, grad_accum_every) * rows
+                        * (cfg.seq_len + 1) * 4)
+        detail["superstep_k"] = superstep_k
+
     return MemoryPlan(
         params_bytes=params_b,
         moments_bytes=moments_b,
@@ -257,6 +273,7 @@ def plan(
         num_params=n,
         detail=detail,
         snapshot_bytes=snapshot_b,
+        superbatch_bytes=superbatch_b,
     )
 
 

@@ -15,7 +15,13 @@ Replaces the reference's ``get_loss_fn`` + Python-side optimizer calls
 * state sharding is derived from the model's logical axis annotations by
   propagating flax metadata boxes through ``optax``'s ``init`` (zeros_like
   preserves the boxes), so optimizer moments shard exactly like their
-  params.
+  params;
+* ``train_multi_step`` goes one further: a ``lax.scan`` fuses K optimizer
+  steps (each ``grad_accum_every`` micro-batches) into ONE XLA program
+  over a staged ``(K, accum, B, L)`` superbatch, so the steady-state loop
+  pays one host dispatch per K steps instead of ``K * accum`` — the
+  pjit-paper loop-fusion pattern (PAPERS.md), with GSPMD propagating the
+  same shardings through the scanned body.
 """
 
 from __future__ import annotations
@@ -30,7 +36,12 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from progen_tpu.parallel.sharding import batch_sharding, logical_rules, unbox
+from progen_tpu.parallel.sharding import (
+    batch_sharding,
+    logical_rules,
+    superbatch_sharding,
+    unbox,
+)
 from progen_tpu.train.loss import batch_loss, cross_entropy
 
 
@@ -47,12 +58,16 @@ class TrainFunctions:
     ``init_state(key)`` creates the (sharded) state; ``train_step(state,
     batch)`` and ``eval_step(state, batch)`` are jitted and mesh-aware.
     ``batch`` is the data-pipeline layout ``(B, seq_len + 1)`` int tokens.
+    ``train_multi_step(state, superbatch)`` fuses K optimizer steps into
+    one XLA program over a ``(K, accum, B, seq_len + 1)`` superbatch and
+    returns K-stacked metrics (see :func:`make_train_functions`).
     """
 
     init_state: Callable
     train_step: Callable
     eval_step: Callable
     state_shardings: Any
+    train_multi_step: Callable | None = None
 
 
 def _boxed_state_factory(model, optimizer, sample_tokens):
@@ -72,8 +87,31 @@ def make_train_functions(
     sample_tokens,
     mesh: Mesh | None = None,
     strategies: Sequence[str] = ("dp",),
+    grad_accum_every: int = 1,
+    lr_schedule: float | Callable | None = None,
 ) -> TrainFunctions:
+    """Build the jitted step functions.
+
+    ``grad_accum_every`` must match the accumulation ``optimizer`` was
+    built with: when > 1 (an ``optax.MultiSteps``-wrapped optimizer),
+    ``train_multi_step`` replaces the ``grad_accum_every`` host dispatches
+    per optimizer step with one on-device scan whose carry holds the f32
+    gradient accumulator — bit-exact with the sequential path (see its
+    docstring for why the body graph is kept identical).
+
+    ``lr_schedule`` (the float or optax schedule behind the optimizer's
+    learning rate): when given, every step's metrics carry ``"lr"`` — the
+    schedule read at the count the update was actually scaled with —
+    computed on device, so loggers need no host-side reconstruction.
+    """
     init_boxed = _boxed_state_factory(model, optimizer, sample_tokens)
+    accum = max(1, int(grad_accum_every))
+    if accum > 1 and not isinstance(optimizer, optax.MultiSteps):
+        raise ValueError(
+            f"grad_accum_every={grad_accum_every} requires an "
+            "optax.MultiSteps optimizer (make_optimizer builds one); got "
+            f"{type(optimizer).__name__}"
+        )
 
     if mesh is not None:
         abstract = jax.eval_shape(init_boxed, jax.random.key(0))
@@ -113,7 +151,21 @@ def make_train_functions(
         logits = apply_model(params, ids)
         return batch_loss(logits, labels)
 
-    def train_step(state: TrainState, batch):
+    def _lr_value(count):
+        # the lr the update at optimizer-step count `count` was scaled
+        # with (optax schedules read the count BEFORE incrementing it)
+        if callable(lr_schedule):
+            return jnp.asarray(lr_schedule(count), jnp.float32)
+        return jnp.asarray(lr_schedule, jnp.float32)
+
+    def _opt_count(state: TrainState):
+        # optimizer-step count BEFORE this update: MultiSteps carries it
+        # explicitly; unaccumulated states advance one per micro-step
+        if accum > 1:
+            return state.opt_state.gradient_step
+        return state.step
+
+    def _train_step_body(state: TrainState, batch):
         loss, grads = jax.value_and_grad(loss_from_batch)(state.params, batch)
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
@@ -121,7 +173,41 @@ def make_train_functions(
         new_state = TrainState(step=state.step + 1, params=params,
                                opt_state=opt_state)
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if lr_schedule is not None:
+            metrics["lr"] = _lr_value(_opt_count(state))
         return new_state, metrics
+
+    train_step = _train_step_body
+
+    def train_multi_step(state: TrainState, superbatch):
+        """K fused optimizer steps: ``superbatch`` is ``(K, accum, B, L)``
+        int tokens; returns the advanced state plus K-stacked metrics
+        ``{"loss": (K, accum), "grad_norm": (K, accum)[, "lr": (K,)]}`` —
+        the trailing ``[-1, -1]`` element of loss/grad_norm is exactly
+        what the per-dispatch loop would have logged, and ``lr`` is the
+        schedule value each optimizer step's update was scaled with.
+
+        The scan body is the EXACT per-dispatch step graph, so the fused
+        path is bit-identical to ``K * accum`` sequential ``train_step``
+        calls: under accumulation the f32 gradient accumulator
+        (``MultiStepsState.acc_grads``) rides in the on-device scan carry
+        instead of round-tripping through ``accum`` host dispatches.  (An
+        algebraically-restructured variant — accumulate all micro-grads,
+        then one inner update — was measured 1 ULP off the sequential
+        path: restructuring the graph changes XLA's FMA fusion.  Keeping
+        the same body graph keeps parity exact; the redundant non-emit
+        optimizer math it carries is elementwise-O(params), noise next to
+        the fwd+bwd FLOPs.)"""
+        k = superbatch.shape[0]
+        flat = superbatch.reshape((k * accum,) + superbatch.shape[2:])
+        new_state, metrics = jax.lax.scan(_train_step_body, state, flat)
+        out = {"loss": metrics["loss"].reshape(k, accum),
+               "grad_norm": metrics["grad_norm"].reshape(k, accum)}
+        if lr_schedule is not None:
+            # one lr per OPTIMIZER step: the group's update is scaled with
+            # the schedule read at its last micro-step (the emit)
+            out["lr"] = metrics["lr"].reshape(k, accum)[:, -1]
+        return new_state, out
 
     def eval_step(state: TrainState, batch):
         ids, labels = batch[:, :-1], batch[:, 1:]
@@ -135,11 +221,20 @@ def make_train_functions(
                 "real_rows": real_rows}
 
     if mesh is not None:
+        super_sharding = superbatch_sharding(mesh)
         train_step = jax.jit(
             train_step,
             in_shardings=(state_shardings, data_sharding),
             out_shardings=(state_shardings, repl),
             donate_argnums=(0,),
+        )
+        # the superbatch is donated too: its (K, accum, B, L) buffer is
+        # dead once scanned, and XLA reuses the HBM for scan temporaries
+        train_multi_step = jax.jit(
+            train_multi_step,
+            in_shardings=(state_shardings, super_sharding),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0, 1),
         )
         eval_step = jax.jit(
             eval_step,
@@ -150,6 +245,7 @@ def make_train_functions(
         )
     else:
         train_step = jax.jit(train_step, donate_argnums=(0,))
+        train_multi_step = jax.jit(train_multi_step, donate_argnums=(0, 1))
         eval_step = jax.jit(eval_step)
 
     return TrainFunctions(
@@ -157,4 +253,5 @@ def make_train_functions(
         train_step=train_step,
         eval_step=eval_step,
         state_shardings=state_shardings,
+        train_multi_step=train_multi_step,
     )

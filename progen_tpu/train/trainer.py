@@ -27,12 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from progen_tpu.checkpoint import CheckpointStore, abstract_state_like
-from progen_tpu.parallel.sharding import batch_sharding
+from progen_tpu.parallel.sharding import batch_sharding, superbatch_sharding
 from progen_tpu.core.mesh import Mesh, MeshConfig, make_mesh
 from progen_tpu.core.precision import make_policy
 from progen_tpu.core.rng import KeySeq
 from progen_tpu.data import decode_tokens, iterator_from_tfrecords_folder
-from progen_tpu.data.prefetch import DevicePrefetcher
+from progen_tpu.data.prefetch import DevicePrefetcher, SuperbatchStager
 from progen_tpu.decode import make_sampler
 from progen_tpu.models import ProGen, ProGenConfig
 from progen_tpu.observe import (
@@ -49,8 +49,26 @@ from progen_tpu.resilience.watchdog import FlightRecorder, Watchdog
 from progen_tpu.train.memory import check_fits, device_hbm_bytes
 from progen_tpu.train.memory import plan as memory_plan
 from progen_tpu.train.optimizer import make_optimizer
-from progen_tpu.train.schedule import lr_at, make_lr_schedule
+from progen_tpu.train.schedule import make_lr_schedule
 from progen_tpu.train.step import make_train_functions
+
+
+def superstep_span(global_step: int, k_max: int, cadences: Sequence[int],
+                   remaining: int) -> int:
+    """Optimizer steps the next fused dispatch may cover: the distance
+    from ``global_step`` to the NEAREST hook boundary among ``cadences``
+    (every-N step counts; a hook fires when ``global_step % every == 0``),
+    capped by ``k_max`` and the ``remaining`` epoch/max_steps budget.
+
+    Always >= 1.  A span never crosses a boundary, and it ENDS exactly on
+    the nearest boundary whenever that is within ``k_max`` steps — so
+    every hook fires at the same global_step as the per-step loop, never
+    skipped and never doubled."""
+    span = min(k_max, remaining)
+    for every in cadences:
+        if every and every > 0:
+            span = min(span, every - global_step % every)
+    return max(1, span)
 
 
 @dataclasses.dataclass
@@ -90,6 +108,13 @@ class TrainerConfig:
     # input-feed double buffering: batches transferred to device ahead of
     # the step that consumes them (0 = synchronous reference-style feed)
     prefetch_depth: int = 2
+    # fused multi-step training: up to K optimizer steps per XLA dispatch
+    # (train_multi_step's lax.scan over a staged (K, accum, B, L)
+    # superbatch; 1 = classic per-step dispatch).  Spans shrink
+    # automatically to land exactly on hook boundaries, so cadence
+    # semantics are unchanged; costs ~2 superbatches of extra HBM
+    # (train/memory.py accounts it).
+    superstep: int = 1
     # checkpoint without stalling training: snapshot the state on-device
     # (one extra state-sized HBM copy) and run the device->host fetch +
     # write in a background thread.  The fetch is the dominant cost on
@@ -137,6 +162,8 @@ class Trainer:
         self.model_config = model_config
         self.cfg = cfg
         self.data_path = data_path
+        if cfg.superstep < 1:
+            raise ValueError(f"superstep must be >= 1, got {cfg.superstep}")
         self.policy = make_policy(cfg.mixed_precision)
         self.mesh: Mesh | None = make_mesh(cfg.mesh) if use_mesh else None
         if (
@@ -200,6 +227,7 @@ class Trainer:
                 grad_accum_every=cfg.grad_accum_every,
                 checkpoint_snapshot=(cfg.background_checkpoint
                                      and jax.process_count() == 1),
+                superstep_k=cfg.superstep,
             )
             gate_device = jax.local_devices()[0]
             err = check_fits(self.memory_plan, device_hbm_bytes(gate_device),
@@ -213,9 +241,14 @@ class Trainer:
         self.fns = make_train_functions(
             self.model, self.optimizer, sample_tokens,
             mesh=self.mesh, strategies=cfg.strategies,
+            grad_accum_every=cfg.grad_accum_every,
+            lr_schedule=self.lr_schedule,
         )
         self.data_sharding = (
             batch_sharding(self.mesh) if self.mesh is not None else None
+        )
+        self.super_sharding = (
+            superbatch_sharding(self.mesh) if self.mesh is not None else None
         )
         self.store = CheckpointStore(checkpoint_path, cfg.checkpoint_keep_n)
         self.tracker = tracker or Tracker(disabled=True)
@@ -272,6 +305,16 @@ class Trainer:
                 self.data_sharding, np.asarray(np_batch)
             )
         return jnp.asarray(np_batch)
+
+    def _super_to_device(self, np_superbatch) -> jax.Array:
+        """Host ``(K, accum, B, L)`` superbatch -> device array for the
+        fused step; multi-process, every host contributes its rows of the
+        batch dim (axis 2) — K and accum are replicated scan axes."""
+        if self.mesh is not None and jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                self.super_sharding, np.asarray(np_superbatch)
+            )
+        return jnp.asarray(np_superbatch)
 
     def _warm_compiles(self, state, global_step: int = 0) -> None:
         """AOT-compile every jitted program the loop will call, BEFORE the
@@ -335,9 +378,30 @@ class Trainer:
         validate_due = hook_due(cfg.validate_every)
         sample_due = cfg.warm_sampler and hook_due(cfg.sample_every)
 
-        programs = [
-            ("train_step", lambda: self.fns.train_step.lower(st, batch)),
-        ]
+        if cfg.superstep > 1:
+            # the superstep loop dispatches exactly two program shapes:
+            # the full-K fused scan and the K=1 residual used to walk up
+            # to hook boundaries (_run_loop_superstep)
+            def super_abstract(k):
+                return jax.ShapeDtypeStruct(
+                    (k, max(1, cfg.grad_accum_every),
+                     cfg.batch_size * jax.process_count(),
+                     self.model_config.seq_len + 1),
+                    jnp.int32,
+                    sharding=self.super_sharding,
+                )
+
+            programs = [
+                ("train_multi_step", lambda: self.fns.train_multi_step.lower(
+                    st, super_abstract(cfg.superstep))),
+                ("train_multi_step[k=1]",
+                 lambda: self.fns.train_multi_step.lower(
+                     st, super_abstract(1))),
+            ]
+        else:
+            programs = [
+                ("train_step", lambda: self.fns.train_step.lower(st, batch)),
+            ]
         if validate_due:
             programs.append(
                 ("eval_step", lambda: self.fns.eval_step.lower(st, batch)))
@@ -484,7 +548,17 @@ class Trainer:
             loop=True, process_count=process_count, process_index=process_index,
             shuffle_buffer=cfg.shuffle_buffer, seed=cfg.seed,
         )
-        if cfg.prefetch_depth > 0:
+        stager = None
+        if cfg.superstep > 1:
+            # fused loop: the stager owns the iterator and assembles
+            # (K, accum, B, L) superbatches, transferring the next one
+            # while the current superstep executes
+            stager = SuperbatchStager(
+                train_it, self._super_to_device,
+                accum=cfg.grad_accum_every, k_max=cfg.superstep,
+                depth=max(1, cfg.prefetch_depth),
+            )
+        elif cfg.prefetch_depth > 0:
             train_it = DevicePrefetcher(
                 train_it, self._to_device, depth=cfg.prefetch_depth
             )
@@ -522,6 +596,12 @@ class Trainer:
         self._watchdog = watchdog
 
         try:
+            if stager is not None:
+                return self._run_loop_superstep(
+                    state, stager, valid_it, total_train, epoch_position,
+                    effective_batch, global_step, seq_cursor, last_loss,
+                    pending_tokens,
+                )
             return self._run_loop(
                 state, train_it, valid_it, total_train, epoch_position,
                 effective_batch, global_step, seq_cursor, last_loss,
@@ -531,7 +611,9 @@ class Trainer:
             if watchdog is not None:
                 watchdog.stop()
             self._watchdog = None
-            if isinstance(train_it, DevicePrefetcher):
+            if stager is not None:
+                stager.close()
+            elif isinstance(train_it, DevicePrefetcher):
                 train_it.close()
             # an exception/KeyboardInterrupt must not kill the daemon
             # checkpoint thread mid-write and lose the last save
@@ -601,10 +683,11 @@ class Trainer:
                         log = {
                             "loss": last_loss,
                             "grad_norm": float(host_metrics["grad_norm"]),
-                            # the update that produced step N was scaled with
-                            # the schedule read at count N-1 (optax reads the
-                            # count before incrementing)
-                            "lr": lr_at(self.lr_schedule, global_step - 1),
+                            # computed on device by the step itself: the
+                            # schedule value this update was actually
+                            # scaled with (no host-side reconstruction
+                            # from global_step)
+                            "lr": float(host_metrics["lr"]),
                         }
                         tps = self.meter.tokens_per_sec_per_chip
                         if tps is not None:
@@ -668,6 +751,167 @@ class Trainer:
                                 "step": global_step, "preempted": True}
 
                     if cfg.max_steps is not None and global_step >= cfg.max_steps:
+                        self._checkpoint(state, seq_cursor, wait=True)
+                        return self._finish(state, last_loss, global_step)
+        return self._finish(state, last_loss, global_step)
+
+    def _run_loop_superstep(self, state, stager, valid_it, total_train,
+                            epoch_position, effective_batch, global_step,
+                            seq_cursor, last_loss, pending_tokens):
+        """Fused-superstep variant of :meth:`_run_loop` (cfg.superstep > 1).
+
+        Each iteration advances a SPAN of optimizer steps with
+        ``train_multi_step`` dispatches: :func:`superstep_span` sizes the
+        span to land exactly on the nearest hook boundary, so every
+        log/checkpoint/validate/sample/epoch boundary fires at the same
+        global_step as the per-step loop.  A full span is ONE K=superstep
+        dispatch; a residual span (boundary closer than K) walks up with
+        the K=1 program instead of compiling one XLA program per distinct
+        span length — the loop only ever compiles two shapes."""
+        cfg = self.cfg
+        seq_len = self.model_config.seq_len
+        process_index = jax.process_index()
+        num_params = sum(x.size for x in jax.tree.leaves(state.params))
+        flops_per_token = model_flops_per_token(self.model_config, num_params,
+                                                sgu_impl=cfg.sgu_impl)
+        peak = peak_flops_per_chip()
+        watchdog = self._watchdog
+        k_max = cfg.superstep
+        cadences = (cfg.log_every, cfg.checkpoint_every, cfg.validate_every,
+                    cfg.sample_every)
+        pending_steps = 0
+        compiled_ks: set = set()
+
+        with profile_trace(cfg.profile_dir):
+            for epoch in range(1, cfg.epochs + 1):
+                if process_index == 0:
+                    print(f"==== starting epoch: {epoch} ====")
+                epoch_start = epoch_position if epoch == 1 else 0
+                steps_per_epoch = max(
+                    1, (total_train - epoch_start) // effective_batch
+                )
+                done = 0
+                while done < steps_per_epoch:
+                    remaining = steps_per_epoch - done
+                    if cfg.max_steps is not None:
+                        remaining = min(remaining,
+                                        cfg.max_steps - global_step)
+                    span = superstep_span(global_step, k_max, cadences,
+                                          remaining)
+                    if watchdog is not None:
+                        watchdog.beat(
+                            f"steps {global_step + 1}..{global_step + span}")
+                    # one inject per optimizer step: a fault plan's at=N
+                    # fires before step N runs, as in the per-step loop
+                    for _ in range(span):
+                        faults.inject("train.step")
+                    k = k_max if span == k_max else 1
+                    # each of the two program shapes compiles inline on
+                    # its first dispatch (donated buffers keep them out of
+                    # _warm_compiles' execution warm-up) — legitimate
+                    # stall the watchdog must not book as a hang
+                    grace = (
+                        watchdog.paused()
+                        if watchdog is not None and k not in compiled_ks
+                        else contextlib.nullcontext()
+                    )
+                    compiled_ks.add(k)
+                    with grace:
+                        for _ in range(span // k):
+                            state, metrics = self.fns.train_multi_step(
+                                state, stager.get(k))
+                    done += span
+                    global_step += span
+                    seq_cursor = seq_cursor + effective_batch * span
+                    pending_tokens += effective_batch * seq_len * span
+                    pending_steps += span
+
+                    will_hook = (
+                        global_step % cfg.checkpoint_every == 0
+                        or global_step % cfg.validate_every == 0
+                        or global_step % cfg.sample_every == 0
+                    )
+                    if global_step % cfg.log_every == 0:
+                        # ONE batched transfer fetches the whole span's
+                        # K-stacked metrics — the sync point the meter
+                        # ticks at, now rating K steps per sync
+                        host_metrics = jax.device_get(metrics)  # graftcheck: disable=host-sync
+                        last_loss = float(host_metrics["loss"][-1, -1])
+                        self.meter.tick(pending_tokens, steps=pending_steps)
+                        pending_tokens = 0
+                        pending_steps = 0
+                        log = {
+                            "loss": last_loss,
+                            "grad_norm": float(
+                                host_metrics["grad_norm"][-1, -1]),
+                            # computed on device by the step itself: the
+                            # schedule value the final update in the span
+                            # was actually scaled with
+                            "lr": float(host_metrics["lr"][-1]),
+                        }
+                        tps = self.meter.tokens_per_sec_per_chip
+                        if tps is not None:
+                            log["tokens_per_sec_per_chip"] = tps
+                            util = mfu(tps, flops_per_token, peak)
+                            if util is not None:
+                                log["mfu"] = util
+                        sps = self.meter.steps_per_sec
+                        if sps is not None:
+                            log["steps_per_sec"] = sps
+                        self.tracker.log(log, global_step)
+                        self._recorder.record("step", step=global_step, **log)
+                        if process_index == 0:
+                            print(f"step {global_step} loss: {last_loss:.4f}")
+
+                    if will_hook and pending_tokens:
+                        # hook cadences need not align with log_every:
+                        # sync and tick BEFORE the hooks so their wall
+                        # time is never rated against these steps' tokens
+                        jax.block_until_ready(metrics["grad_norm"])  # graftcheck: disable=host-sync
+                        self.meter.tick(pending_tokens, steps=pending_steps)
+                        pending_tokens = 0
+                        pending_steps = 0
+
+                    hooks_ran = False
+                    if global_step % cfg.checkpoint_every == 0:
+                        self._checkpoint(state, seq_cursor)
+                        hooks_ran = True
+
+                    if global_step % cfg.validate_every == 0:
+                        vbatch = self._to_device(next(valid_it))
+                        vmetrics = self.fns.eval_step(state, vbatch)
+                        vloss = float(jax.device_get(vmetrics["loss"]))  # graftcheck: disable=host-sync
+                        self.tracker.log({"valid_loss": vloss}, global_step)
+                        if process_index == 0:
+                            print(f"valid_loss: {vloss:.4f}")
+                        hooks_ran = True
+
+                    if global_step % cfg.sample_every == 0:
+                        self._sample_and_log(state, next(valid_it),
+                                             global_step)
+                        hooks_ran = True
+
+                    if hooks_ran:
+                        # hook time (eval/sampling/checkpoint IO) is not
+                        # training time; drop it from the meter's window
+                        self.meter.rebase()
+                        if watchdog is not None:
+                            watchdog.beat(f"hooks at step {global_step}")
+
+                    if (self._preempt_requested
+                            or self.store.reached_preemption(global_step)):
+                        self._checkpoint(state, seq_cursor, wait=True)
+                        if process_index == 0:
+                            print(
+                                f"preemption checkpoint at step "
+                                f"{global_step}; exiting (resume restarts "
+                                "here)"
+                            )
+                        return {"state": state, "loss": last_loss,
+                                "step": global_step, "preempted": True}
+
+                    if (cfg.max_steps is not None
+                            and global_step >= cfg.max_steps):
                         self._checkpoint(state, seq_cursor, wait=True)
                         return self._finish(state, last_loss, global_step)
         return self._finish(state, last_loss, global_step)

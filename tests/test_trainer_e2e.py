@@ -258,6 +258,42 @@ def test_multi_epoch_shuffled_resume_is_bit_exact(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_superstep_run_crosses_hooks_and_resumes_bit_exact(data_dir, tmp_path):
+    """--superstep 2 through validate (3, 6) and checkpoint (4)
+    boundaries: the cadence mix forces BOTH fused program shapes (full
+    K=2 spans at 0->2 and 4->6, residual K=1 walks at 2->3->4), a
+    "crash" at the step-4 checkpoint, and a resume — which must land on
+    the same seq cursor and bit-identical params as the unfused loop
+    run straight through."""
+    cadences = dict(validate_every=3, checkpoint_every=4, log_every=2,
+                    sample_every=1000)
+
+    ref = _flex_trainer(data_dir, tmp_path / "ck_ref", max_steps=6,
+                        superstep=1, **cadences)
+    out_ref = ref.run()
+    assert out_ref["step"] == 6
+    ref.store.close()
+
+    t1 = _flex_trainer(data_dir, tmp_path / "ck_fused", max_steps=4,
+                       superstep=2, **cadences)
+    out1 = t1.run()
+    assert out1["step"] == 4
+    t1.store.close()
+
+    t2 = _flex_trainer(data_dir, tmp_path / "ck_fused", max_steps=6,
+                       superstep=2, **cadences)
+    state, start_seq, _ = t2.restore_or_init()
+    assert int(state.step) == 4 * 2       # micro-steps: grad_accum 2
+    assert start_seq == 4 * 4             # same cursor the unfused loop keeps
+    out2 = t2.run()
+    assert out2["step"] == 6
+    t2.store.close()
+
+    for a, b in zip(jax.tree.leaves(out_ref["state"].params),
+                    jax.tree.leaves(out2["state"].params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class _FakeSampler:
     """Records warm-execution and AOT-lower calls without any real decode."""
 

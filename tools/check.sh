@@ -21,4 +21,10 @@ JAX_PLATFORMS=cpu python -m pytest \
     tests/test_decode.py tests/test_observe.py \
     -q -m 'not slow' -p no:cacheprovider
 
+echo "== superstep quick-bench smoke =="
+# tiny-shape K-sweep on CPU: proves the fused dispatch path runs end to
+# end and emits parseable JSON (full sweep: benchmarks/superstep.md)
+JAX_PLATFORMS=cpu python benchmarks/bench_superstep.py \
+    --steps 8 --reps 1 --ks 1,8 --batch 2
+
 echo "== all checks passed =="

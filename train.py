@@ -93,6 +93,13 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
 @click.option("--prefetch_depth", default=2,
               help="device batches buffered ahead of the step consuming "
                    "them (0 = synchronous reference-style feed)")
+@click.option("--superstep", default=1,
+              help="fuse up to K optimizer steps per XLA dispatch "
+                   "(lax.scan over a staged (K, accum, B, L) superbatch; "
+                   "1 = per-step dispatch).  Spans shrink to land on hook "
+                   "boundaries, so log/checkpoint/validate/sample cadences "
+                   "are unchanged; costs ~2 superbatches of HBM "
+                   "(docs/TRAINING.md)")
 @click.option("--background_checkpoint/--no_background_checkpoint",
               default=True,
               help="checkpoint via an on-device state snapshot + background "
@@ -196,6 +203,7 @@ def main(**flags):
         attn_impl=flags["attn_impl"],
         sgu_impl=flags["sgu_impl"],
         prefetch_depth=flags["prefetch_depth"],
+        superstep=flags["superstep"],
         background_checkpoint=flags["background_checkpoint"],
         log_every=flags["log_every"],
         max_steps=flags["max_steps"],
