@@ -35,6 +35,10 @@ Any failure INSIDE run_one (backend init at first device use, OOM,
 compile error) emits the same structured JSON error record as a failed
 startup probe and exits 0 — the driver always gets parseable output.
 
+``--compile_cache DIR`` persists compiled XLA executables across runs
+(also via PROGEN_COMPILE_CACHE; '0' disables) so repeat benchmark
+invocations skip recompilation.
+
 PROGEN_BENCH_CONFIGS=small,base,large runs the whole ladder — one JSON
 line per config, each with the per-config defaults from LADDER (the
 best-known single-chip setting for that scale, benchmarks/configs.md) —
@@ -52,9 +56,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from progen_tpu.core.cache import enable_compilation_cache
 from progen_tpu.observe.gitinfo import git_sha
+from progen_tpu.observe.platform import emit_error_record, probe_backend
+
+# legacy aliases — bench_sgu/bench_superstep historically imported these
+# from here; the shared implementations live in observe/platform.py
+_emit_error_record = emit_error_record
+_probe_backend = probe_backend
 
 NORTH_STAR_TOKENS_PER_SEC_PER_CHIP = 40_000.0
+
+
+def _parse_args():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="training-step throughput benchmark (knobs are "
+                    "PROGEN_BENCH_* env vars; see module docstring)")
+    p.add_argument(
+        "--compile_cache", metavar="DIR", default=None,
+        help="JAX persistent compilation cache directory ('0' disables); "
+             "overrides PROGEN_COMPILE_CACHE, default "
+             "~/.cache/progen_tpu/xla")
+    return p.parse_args()
 
 
 def synthetic_uniref_batch(rng: np.random.Generator, batch: int, seq_len: int):
@@ -229,21 +254,6 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
     }
 
 
-def _emit_error_record(e: BaseException) -> None:
-    """One parseable JSON error line (stdout, rc stays 0) with a platform
-    stamp — the driver ingests this instead of a raw traceback."""
-    import platform
-
-    print(json.dumps({
-        "error": f"{type(e).__name__}: {e}",
-        "metric": None,
-        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
-        "jax_version": jax.__version__,
-        "python": platform.python_version(),
-        "git_sha": git_sha(),
-    }), flush=True)
-
-
 def _run_one_guarded(config_name: str, **kwargs) -> bool:
     """Run one bench config, printing its JSON line; any failure inside
     (backend init at first device use — the startup probe only guards a
@@ -259,55 +269,11 @@ def _run_one_guarded(config_name: str, **kwargs) -> bool:
     return True
 
 
-def _probe_backend() -> bool:
-    """Check the accelerator backend comes up, retrying transient failures.
-
-    TPU runtime init at capture time can fail (libtpu UNAVAILABLE grpc
-    error when another process briefly holds the chips) or HANG outright
-    in its metadata fetches — and the hang holds the GIL, so the probe
-    runs ``jax.devices()`` in a SUBPROCESS (a thread-based attempt
-    timeout can never fire).  Attempts are retried via the resilience
-    layer (``PROGEN_BENCH_RETRY_*`` env knobs); when the backend still
-    won't come up, emit a parseable JSON ERROR RECORD on stdout (rc 0)
-    with a platform stamp instead of a raw traceback the driver can't
-    ingest, and return False.
-    """
-    import subprocess
-
-    from progen_tpu.resilience.retry import (
-        AttemptTimeout, RetryPolicy, retry_call,
-    )
-
-    import dataclasses
-
-    policy = RetryPolicy.from_env("PROGEN_BENCH_RETRY")
-    per_try = policy.attempt_timeout or 60.0
-    # the subprocess enforces the per-attempt bound itself — don't stack
-    # the thread-based attempt timeout on top
-    policy = dataclasses.replace(policy, attempt_timeout=None)
-
-    def probe():
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True, text=True, timeout=per_try,
-            )
-        except subprocess.TimeoutExpired:
-            raise AttemptTimeout(
-                f"backend init exceeded {per_try:.0f}s") from None
-        if proc.returncode != 0:
-            tail = (proc.stderr or "").strip().splitlines()[-8:]
-            raise RuntimeError("backend init failed: " + " | ".join(tail))
-
-    try:
-        retry_call(probe, policy=policy, label="backend-init")
-        return True
-    except Exception as e:  # RetryError or fatal init error: report, don't raise
-        _emit_error_record(e)
-        return False
-
-
 def main() -> None:
+    args = _parse_args()
+    if args.compile_cache is not None:
+        os.environ["PROGEN_COMPILE_CACHE"] = args.compile_cache
+    enable_compilation_cache()
     if not _probe_backend():
         return
     steps = int(os.environ.get("PROGEN_BENCH_STEPS", "10"))

@@ -16,6 +16,13 @@ Usage::
 
 A warmup pass (engine compile: admission + decode chunk programs) runs
 before the clock starts.
+
+``--paged`` switches the engine to the paged SGU gate cache (page pool +
+per-request page tables, ``decode/paging.py``); ``--budget-slots N``
+sizes the pool to the same modeled gate-row HBM as a fixed-slot engine
+with N slots, for equal-budget concurrency comparisons — the record's
+``max_in_flight`` and ``gate_hbm_bytes`` fields carry the comparison
+(see benchmarks/paged.md).
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from progen_tpu.observe.gitinfo import git_sha
+from progen_tpu.observe.platform import probe_backend
 
 
 def main() -> None:
@@ -51,11 +59,37 @@ def main() -> None:
     ap.add_argument("--prime-min", type=int, default=8)
     ap.add_argument("--prime-max", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-len", type=int, default=None,
+                    help="engine max_len (the serving contract: longest "
+                         "request the deployment admits); default sizes "
+                         "to this run's worst case prime+max_new+1")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged SGU gate cache (global page pool) instead "
+                         "of per-slot fixed max_len slabs")
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="page-pool size; default covers num_slots full "
+                         "rows (no sharing pressure)")
+    ap.add_argument("--paged-impl", choices=("xla", "pallas"), default="xla")
+    ap.add_argument("--no-prefix-cache", action="store_true")
+    ap.add_argument("--budget-slots", type=int, default=None,
+                    help="with --paged and no --num-pages: size the pool "
+                         "to the SAME modeled gate-cache HBM as a "
+                         "fixed-slot engine with this many slots "
+                         "(equal-budget comparison)")
+    ap.add_argument("--compile_cache", metavar="DIR", default=None,
+                    help="JAX persistent compilation cache dir ('0' "
+                         "disables); overrides PROGEN_COMPILE_CACHE")
     args = ap.parse_args()
 
     from progen_tpu.core.cache import enable_compilation_cache
 
+    if args.compile_cache is not None:
+        os.environ["PROGEN_COMPILE_CACHE"] = args.compile_cache
     enable_compilation_cache()
+
+    if not probe_backend(metric="serving"):
+        return
 
     from progen_tpu.core.precision import make_policy
     from progen_tpu.decode import Request, ServingEngine
@@ -83,10 +117,21 @@ def main() -> None:
             submit_time=submit_time,
         )
 
-    max_len = min(cfg.seq_len, pmax + args.max_new + 1)
+    max_len = args.max_len or min(cfg.seq_len, pmax + args.max_new + 1)
+    num_pages = args.num_pages
+    if args.paged and num_pages is None and args.budget_slots is not None:
+        from progen_tpu.train.memory import equal_budget_pages
+
+        num_pages = equal_budget_pages(cfg, dense_slots=args.budget_slots,
+                                       max_len=max_len,
+                                       page_size=args.page_size)
+    paged_kwargs = dict(
+        paged=True, page_size=args.page_size, num_pages=num_pages,
+        paged_impl=args.paged_impl, prefix_cache=not args.no_prefix_cache,
+    ) if args.paged else {}
     engine = ServingEngine(cfg, params, policy=policy,
                            num_slots=args.slots, chunk_size=args.chunk,
-                           max_len=max_len)
+                           max_len=max_len, **paged_kwargs)
 
     # warmup: compile the admission + chunk programs off the clock
     for i in range(min(2, args.slots)):
@@ -99,6 +144,7 @@ def main() -> None:
     t0 = time.perf_counter()
     done: list = []
     nxt = 0
+    max_in_flight = 0
     while len(done) < args.requests:
         now = time.perf_counter() - t0
         while nxt < args.requests and arrivals[nxt] <= now:
@@ -109,11 +155,20 @@ def main() -> None:
             # block on the queue here)
             time.sleep(max(0.0, arrivals[nxt] - (time.perf_counter() - t0)))
             continue
-        done.extend(engine.step())
+        done_now = engine.step()
+        done.extend(done_now)
+        # slots live DURING this chunk: survivors + those that completed
+        max_in_flight = max(max_in_flight,
+                            engine.num_active + len(done_now))
     wall = time.perf_counter() - t0
 
     latencies = sorted(c.latency for c in done)
     gen_tokens = int(sum(len(c.tokens) for c in done))
+    from progen_tpu.train.memory import serving_plan
+
+    plan = serving_plan(cfg, num_slots=args.slots, max_len=max_len,
+                        paged=args.paged, page_size=args.page_size,
+                        num_pages=num_pages)
     record = {
         "metric": "serving",
         "config": args.config,
@@ -122,6 +177,13 @@ def main() -> None:
         "slots": args.slots,
         "chunk": args.chunk,
         "max_new_tokens": args.max_new,
+        "max_len": max_len,
+        "paged": args.paged,
+        "max_in_flight": max_in_flight,
+        # the budgeted resource: gate-row HBM (pool for paged, slots x
+        # max_len slabs for fixed) — rings/carries are per-slot in BOTH
+        # modes and excluded from the equal-budget comparison
+        "gate_hbm_bytes": plan.pageable_bytes,
         "wall_s": round(wall, 3),
         "generated_tokens": gen_tokens,
         "tokens_per_sec": round(gen_tokens / wall, 1),
@@ -131,6 +193,15 @@ def main() -> None:
         "platform": jax.devices()[0].platform,
         "git_sha": git_sha(),
     }
+    if args.paged:
+        record.update({
+            "page_size": args.page_size,
+            "num_pages": engine._pool.num_pages,
+            "prefix_cache": not args.no_prefix_cache,
+            "prefix_hits": engine.prefix_hits,
+            "evictions": engine.evictions,
+            "pause_events": engine.pause_events,
+        })
     print(json.dumps(record), flush=True)
 
 

@@ -104,10 +104,9 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=5)
     args = ap.parse_args()
 
-    # reuse bench.py's retried subprocess probe + JSON error record
-    from bench import _probe_backend
+    from progen_tpu.observe.platform import probe_backend
 
-    if not _probe_backend():
+    if not probe_backend():
         return
 
     from progen_tpu.ops.pallas_sgu import sgu_block_flops

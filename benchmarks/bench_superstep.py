@@ -141,10 +141,9 @@ def main() -> None:
     if bad:
         ap.error(f"--steps {args.steps} not divisible by K in {bad}")
 
-    # reuse bench.py's retried subprocess probe + JSON error record
-    from bench import _probe_backend
+    from progen_tpu.observe.platform import probe_backend
 
-    if not _probe_backend():
+    if not probe_backend():
         return
 
     cfg, fns = build(args.config, args.batch, args.accum)

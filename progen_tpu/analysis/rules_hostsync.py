@@ -45,10 +45,19 @@ HOT_ZONES: tuple[Zone, ...] = (
     ),
     Zone(
         r"decode/engine\.py$",
-        r"ServingEngine\.(step|submit|run_until_idle|_admit_pending|_harvest_done)$",
+        r"ServingEngine\.(step|submit|run_until_idle|_admit_pending"
+        r"|_admit_pending_paged|_plan_slot_pages|_free_slot_pages"
+        r"|_evict_slot|_ensure_chunk_pages|_harvest_done)$",
         frozenset({"_inflight", "_queue", "completions", "config",
-                   "num_slots", "max_len", "chunks_run"}),
+                   "num_slots", "max_len", "chunks_run", "_pool",
+                   "_slot_pages", "_page_table", "_paused", "_host_stop",
+                   "_admit_order", "_admit_seq", "page_size",
+                   "pages_per_row", "paged", "chunk_size", "evictions",
+                   "pause_events", "prefix_hits"}),
     ),
+    # the page pool is pure host bookkeeping between dispatches: nothing
+    # in it may touch a device value, so every sync call is a finding
+    Zone(r"decode/paging\.py$", r"PagePool\..*$"),
     Zone(r"train/step\.py$",
          r".*\.(train_step|_train_step_body|train_multi_step|eval_step)$"),
 )

@@ -1,7 +1,21 @@
 from progen_tpu.decode.engine import Completion, Request, ServingEngine
-from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
+from progen_tpu.decode.incremental import (
+    ProGenDecodeStep,
+    ProGenPagedDecodeStep,
+    init_caches,
+    init_gate_pool,
+)
+from progen_tpu.decode.paging import (
+    DUMP_PAGE,
+    NULL_PAGE,
+    PagePool,
+    SlotPages,
+    pages_for_span,
+    prefix_key,
+)
 from progen_tpu.decode.prefill import (
     harvest_caches,
+    harvest_gate_pages,
     make_prefiller,
     pad_prime_length,
 )
@@ -16,17 +30,26 @@ from progen_tpu.decode.sampler import (
 
 __all__ = [
     "Completion",
+    "DUMP_PAGE",
+    "NULL_PAGE",
+    "PagePool",
     "ProGenDecodeStep",
+    "ProGenPagedDecodeStep",
     "Request",
     "ServingEngine",
+    "SlotPages",
     "gumbel_topk_sample",
     "gumbel_topk_sample_batched",
     "harvest_caches",
+    "harvest_gate_pages",
     "init_caches",
+    "init_gate_pool",
     "make_chunked_sampler",
     "make_prefiller",
     "make_sampler",
     "pad_prime_length",
+    "pages_for_span",
+    "prefix_key",
     "teacher_forced_logits",
     "truncate_after_eos",
 ]

@@ -55,10 +55,25 @@ import numpy as np
 from jax.sharding import Mesh
 
 from progen_tpu.core.precision import Policy, make_policy
-from progen_tpu.decode.incremental import ProGenDecodeStep, init_caches
+from progen_tpu.decode.incremental import (
+    ProGenDecodeStep,
+    ProGenPagedDecodeStep,
+    init_caches,
+    init_gate_pool,
+)
+from progen_tpu.decode.paging import (
+    DUMP_PAGE,
+    NULL_PAGE,
+    RESERVED_PAGES,
+    PagePool,
+    SlotPages,
+    pages_for_span,
+    prefix_key,
+)
 from progen_tpu.decode.prefill import (
     _constrain_caches,
     harvest_caches,
+    harvest_gate_pages,
     pad_prime_length,
 )
 from progen_tpu.decode.sampler import gumbel_topk_sample_batched
@@ -109,6 +124,25 @@ class ServingEngine:
     ``num_slots`` is the max concurrent requests; ``chunk_size`` the
     decode steps per device program; ``max_len`` the sequence budget per
     slot (prime + generated, ≤ ``config.seq_len``).
+
+    **Paged mode** (``paged=True``): the per-slot SGU gate cache — the
+    one ``max_len``-sized buffer, i.e. this architecture's pageable "KV"
+    — moves into a global page pool (``decode/paging.py``): pages are
+    allocated on demand as positions advance, freed (refcounted) at
+    completion, and shared across requests with a common prompt prefix.
+    Admission is gated by free PAGES as well as free slots; when the pool
+    runs dry mid-decode, starved slots are PAUSED (their rows freeze —
+    position, key and sequence do not advance, so the trajectory is
+    delayed, never altered) and, if every live slot is starved, the most
+    recently admitted one is evicted back to the queue head (restart
+    preemption: determinism means replaying it reproduces the identical
+    prefix of tokens).  Greedy outputs are token-for-token identical to
+    the fixed-slot engine — the XLA fallback contraction is bit-matched
+    to the dense decode path (``ops/pallas_paged_attention.py``).
+
+    ``num_pages`` counts pool pages incl. the 2 reserved ones (default:
+    full budget — every slot can reach ``max_len``); ``paged_impl`` picks
+    the ragged kernel (``"pallas"``) or the gather fallback (``"xla"``).
     """
 
     def __init__(self, config: ProGenConfig, params, *,
@@ -116,7 +150,10 @@ class ServingEngine:
                  chunk_size: int = 32, max_len: int | None = None,
                  mesh: Mesh | None = None,
                  strategies: Sequence[str] = ("dp",),
-                 params_shardings=None):
+                 params_shardings=None,
+                 paged: bool = False, page_size: int = 16,
+                 num_pages: int | None = None, paged_impl: str = "xla",
+                 prefix_cache: bool = True):
         self.config = config
         self.policy = policy or make_policy()
         self.num_slots = num_slots
@@ -147,10 +184,35 @@ class ServingEngine:
             trace_ctx = contextlib.ExitStack
         self._trace_ctx = trace_ctx
 
-        self._step_model = ProGenDecodeStep(config=config, policy=self.policy)
+        self.paged = paged
+        if paged:
+            self.page_size = page_size
+            self.pages_per_row = -(-self.max_len // page_size)
+            if num_pages is None:
+                num_pages = RESERVED_PAGES + num_slots * self.pages_per_row
+            self._pool = PagePool(num_pages, page_size,
+                                  prefix_caching=prefix_cache)
+            self._slot_pages: dict[int, SlotPages] = {}
+            self._page_table = np.zeros((num_slots, self.pages_per_row),
+                                        np.int32)
+            self._paused = np.zeros((num_slots,), bool)
+            self._host_stop = np.zeros((num_slots,), np.int64)
+            self._admit_seq = 0
+            self._admit_order: dict[int, int] = {}  # slot -> admission seq
+            self.evictions = 0
+            self.pause_events = 0
+            self.prefix_hits = 0
+            self._paged_step_model = ProGenPagedDecodeStep(
+                config=config, n_rows=self.max_len, policy=self.policy,
+                impl=paged_impl)
+            self._decode_chunk = jax.jit(self._decode_chunk_paged_impl)
+            self._admit = jax.jit(self._admit_paged_impl)
+        else:
+            self._step_model = ProGenDecodeStep(config=config,
+                                                policy=self.policy)
+            self._decode_chunk = jax.jit(self._decode_chunk_impl)
+            self._admit = jax.jit(self._admit_impl)
         self._prefill_model = ProGen(config=config, policy=self.policy)
-        self._decode_chunk = jax.jit(self._decode_chunk_impl)
-        self._admit = jax.jit(self._admit_impl)
         self.state = self._init_state()
 
     # ---------------------------------------------------------------- state
@@ -158,7 +220,13 @@ class ServingEngine:
     def _init_state(self) -> dict:
         s, L = self.num_slots, self.max_len
         with self._trace_ctx():
-            caches = init_caches(self.config, s, self.policy, decode_len=L)
+            caches = init_caches(self.config, s, self.policy, decode_len=L,
+                                 with_sgu=not self.paged)
+            if self.paged:
+                caches.pop("sgu_gate")
+                caches["sgu_pool"] = init_gate_pool(
+                    self.config, self._pool.num_pages, self.page_size,
+                    self.policy)
             if self.mesh is not None:
                 caches = _constrain_caches(caches, self.mesh, self.strategies)
         keys = jax.vmap(jax.random.key)(jnp.zeros((s,), jnp.uint32))
@@ -270,6 +338,126 @@ class ServingEngine:
             "temp": merge(temp, state["temp"]),
         }
 
+    # -------------------------------------------------------- paged decoding
+
+    _RING_KEYS = ("attn_prev", "ff_prev", "k", "v")
+
+    def _decode_chunk_paged_impl(self, params, state, table, paused):
+        """Paged twin of ``_decode_chunk_impl``: the page ``table`` and
+        ``paused`` mask ride in as data (host-side allocation decisions
+        never retrace the program).  Paused rows run the step but are
+        fully masked — sequence/position/key freeze AND their ring/carry
+        writes are dropped (a paused row's carries still hold position
+        ``pos-1``'s activations; letting the discarded speculative step
+        overwrite them would corrupt the real step after unpausing).
+        Pool writes are masked inside the step via ``write_ok``."""
+        with self._trace_ctx():
+            if self.mesh is not None:
+                state = {**state, "caches": _constrain_caches(
+                    state["caches"], self.mesh, self.strategies)}
+
+            def body(st, _):
+                live = st["active"] & ~st["done"] & ~paused
+                pos = st["pos"]
+                tok = jnp.take_along_axis(st["seq"], pos[:, None],
+                                          axis=1)[:, 0]
+                logits, caches = self._paged_step_model.apply(
+                    params, tok, pos, st["caches"], table, live)
+
+                def mrg(new, old):
+                    m = live.reshape((-1,) + (1,) * (old.ndim - 1))
+                    return jnp.where(m, new, old)
+
+                caches = {
+                    **{k: jax.tree.map(mrg, caches[k], st["caches"][k])
+                       for k in self._RING_KEYS},
+                    "sgu_pool": caches["sgu_pool"],
+                }
+                keys = jax.random.wrap_key_data(st["keys"])
+                split = jax.vmap(jax.random.split)(keys)  # (S, 2) keys
+                nxt = gumbel_topk_sample_batched(
+                    split[:, 1], logits, st["top_k"], st["temp"]
+                ).astype(jnp.int32)
+                writepos = jnp.clip(pos + 1, 0, self.max_len - 1)
+                cur = jnp.take_along_axis(st["seq"], writepos[:, None],
+                                          axis=1)[:, 0]
+                val = jnp.where(live, nxt, cur)
+                seq = st["seq"].at[
+                    jnp.arange(self.num_slots), writepos].set(val)
+                new_pos = jnp.where(live, pos + 1, pos)
+                done = st["done"] | (live & (
+                    (val == EOS_ID) | (new_pos + 1 >= st["stop"])))
+                # key advances only on the slot's own live steps (see the
+                # dense body) — pausing therefore delays, never alters
+                new_keys = jnp.where(
+                    live[:, None], jax.random.key_data(split[:, 0]),
+                    st["keys"])
+                return {**st, "seq": seq, "caches": caches, "pos": new_pos,
+                        "done": done, "keys": new_keys}, None
+
+            state, _ = jax.lax.scan(body, state, None,
+                                    length=self.chunk_size)
+        return state
+
+    def _admit_paged_impl(self, params, state, tokens, lengths, stops,
+                          seeds, top_k, temp, mask, table, wtable):
+        """Paged twin of ``_admit_impl``: rings/carries harvest and merge
+        as in the dense path, but gate rows scatter straight into the
+        page pool through the WRITE table (``wtable`` — private pages
+        only; prefix-shared and dummy rows dump)."""
+        cfg = self.config
+        with self._trace_ctx():
+            logits, varz = self._prefill_model.apply(
+                params, tokens, mutable=["cache"])
+            caches_new = harvest_caches(cfg, varz["cache"], lengths,
+                                        self.policy, self.max_len,
+                                        with_sgu=False)
+            pool_new = harvest_gate_pages(
+                cfg, varz["cache"], lengths,
+                state["caches"]["sgu_pool"], wtable, self.policy)
+            if self.mesh is not None:
+                caches_new = _constrain_caches(caches_new, self.mesh,
+                                               self.strategies)
+
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0].astype(jnp.float32)
+        keys = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
+        split = jax.vmap(jax.random.split)(keys)
+        first = gumbel_topk_sample_batched(
+            split[:, 1], last, top_k, temp).astype(jnp.int32)
+
+        s, L = self.num_slots, self.max_len
+        p_pad = tokens.shape[1]
+        tok_L = tokens[:, :L] if p_pad >= L else jnp.pad(
+            tokens, ((0, 0), (0, L - p_pad)))
+        seq = tok_L * (jnp.arange(L)[None, :] < lengths[:, None])
+        seq = seq.at[jnp.arange(s), lengths].set(first)
+        pos = lengths
+        done = (first == EOS_ID) | (pos + 1 >= stops)
+
+        def merge(new, old):
+            m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
+            return jnp.where(m, new, old)
+
+        merged_caches = {
+            **{k: jax.tree.map(merge, caches_new[k], state["caches"][k])
+               for k in self._RING_KEYS},
+            "sgu_pool": pool_new,
+        }
+        return {
+            "seq": merge(seq, state["seq"]),
+            "caches": merged_caches,
+            "pos": merge(pos, state["pos"]),
+            "start": merge(lengths, state["start"]),
+            "stop": merge(stops, state["stop"]),
+            "active": merge(jnp.ones((s,), bool), state["active"]),
+            "done": merge(done, state["done"]),
+            "keys": merge(jax.random.key_data(split[:, 0]), state["keys"]),
+            "top_k": merge(top_k, state["top_k"]),
+            "temp": merge(temp, state["temp"]),
+        }
+
     # ----------------------------------------------------------------- API
 
     def submit(self, request: Request) -> None:
@@ -284,6 +472,14 @@ class ServingEngine:
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"request {request.uid!r}: max_new_tokens must be >= 1")
+        if self.paged:
+            stop = min(n + request.max_new_tokens, self.max_len)
+            worst = pages_for_span(stop - 1, self.page_size)
+            if worst > self._pool.capacity:
+                raise ValueError(
+                    f"request {request.uid!r}: needs up to {worst} pages "
+                    f"but the pool only has {self._pool.capacity} — "
+                    f"raise num_pages or lower max_new_tokens")
         self._queue.append(request)
 
     @property
@@ -295,6 +491,9 @@ class ServingEngine:
         return len(self._inflight)
 
     def _admit_pending(self) -> None:
+        if self.paged:
+            self._admit_pending_paged()
+            return
         free = [i for i in range(self.num_slots) if i not in self._inflight]
         if not free or not self._queue:
             return
@@ -329,6 +528,163 @@ class ServingEngine:
             jnp.asarray(lengths), jnp.asarray(stops), jnp.asarray(seeds),
             jnp.asarray(top_k), jnp.asarray(temp), jnp.asarray(mask))
 
+    def _admit_pending_paged(self) -> None:
+        """FIFO admission gated by free slots AND free pages.
+
+        The head of the queue is admitted only if the pool can cover its
+        whole prime plus the first sampled token WITHOUT prefix sharing
+        (a conservative bound — actual planning below shares whatever it
+        can, so the allocation never exceeds the reservation); a blocked
+        head DEFERS everything behind it (no starvation reordering).
+        """
+        free = [i for i in range(self.num_slots) if i not in self._inflight]
+        batch: list[tuple[int, Request]] = []
+        reserved = 0
+        while free and self._queue:
+            r = self._queue[0]
+            need = pages_for_span(len(r.tokens), self.page_size)
+            if not self._pool.can_allocate(reserved + need):
+                break  # head-of-line blocks: deferral, not reordering
+            reserved += need
+            batch.append((free.pop(0), self._queue.popleft()))
+        if not batch:
+            return
+
+        s = self.num_slots
+        longest = max(len(r.tokens) for _, r in batch)
+        p_pad = pad_prime_length(longest, self.config.window_size,
+                                 self.config.seq_len, bucket=True)
+        tokens = np.zeros((s, p_pad), np.int32)
+        lengths = np.ones((s,), np.int32)  # dummy rows: 1-token prime
+        stops = np.full((s,), 2, np.int32)
+        seeds = np.zeros((s,), np.uint32)
+        top_k = np.zeros((s,), np.int32)
+        temp = np.ones((s,), np.float32)
+        mask = np.zeros((s,), bool)
+        wtable = np.full((s, self.pages_per_row), DUMP_PAGE, np.int32)
+        for slot, r in batch:
+            t = np.asarray(r.tokens, np.int32)
+            tokens[slot, : len(t)] = t
+            lengths[slot] = len(t)
+            stops[slot] = min(len(t) + r.max_new_tokens, self.max_len)
+            seeds[slot] = np.uint32(int(r.seed) & 0xFFFFFFFF)
+            top_k[slot] = 0 if r.top_k is None else int(r.top_k)
+            temp[slot] = float(r.temperature)
+            mask[slot] = True
+            self._inflight[slot] = r
+            self._host_stop[slot] = stops[slot]
+            self._admit_order[slot] = self._admit_seq
+            self._admit_seq += 1
+            self._paused[slot] = False
+            self._plan_slot_pages(slot, r, p_pad, wtable)
+
+        self.state = self._admit(
+            self._params, self.state, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(stops), jnp.asarray(seeds),
+            jnp.asarray(top_k), jnp.asarray(temp), jnp.asarray(mask),
+            jnp.asarray(self._page_table), jnp.asarray(wtable))
+
+    def _plan_slot_pages(self, slot: int, r: Request, p_pad: int,
+                         wtable: np.ndarray) -> None:
+        """Build the slot's page list for rows ``[0, P]`` (prime + first
+        sampled token): longest run of prefix-cache hits first, fresh
+        private pages for the rest.  Fills the slot's ``_page_table`` row
+        and its ``wtable`` row (private pages only — shared pages were
+        filled by the request that first computed them and MUST stay
+        read-only: rewriting them from a different prefill batch shape
+        could perturb the sharer's bits)."""
+        ps = self.page_size
+        p = len(r.tokens)
+        n_pages = p // ps + 1  # decode writes row P before any page grows
+        n_full = p // ps       # full pages strictly inside the prime
+        shared: list[int] = []
+        for j in range(n_full):
+            pid = self._pool.lookup_prefix(prefix_key(p_pad, r.tokens,
+                                                      (j + 1) * ps))
+            if pid is None:
+                break
+            shared.append(pid)
+        fresh = self._pool.allocate(n_pages - len(shared))
+        assert fresh is not None, "admission reserved pages conservatively"
+        for pid in shared:
+            self._pool.retain(pid)
+        self.prefix_hits += len(shared)
+        pages = shared + fresh
+        for j in range(len(shared), n_full):
+            self._pool.register_prefix(
+                prefix_key(p_pad, r.tokens, (j + 1) * ps), pages[j])
+        self._slot_pages[slot] = SlotPages(pages=pages, shared=len(shared))
+        self._page_table[slot, :] = NULL_PAGE
+        self._page_table[slot, : n_pages] = pages
+        wtable[slot, : n_pages] = [DUMP_PAGE] * len(shared) + fresh
+
+    def _free_slot_pages(self, slot: int) -> None:
+        sp = self._slot_pages.pop(slot, None)
+        if sp is None:
+            return
+        for pid in sp.pages:
+            self._pool.release(pid)
+        self._page_table[slot, :] = NULL_PAGE
+        self._paused[slot] = False
+        self._admit_order.pop(slot, None)
+
+    def _evict_slot(self, slot: int) -> None:
+        """Restart preemption: free the slot's pages and push its request
+        back to the FRONT of the queue.  Replaying from scratch is safe —
+        a trajectory depends only on (params, prime, seed, knobs), so the
+        re-decode reproduces the identical token prefix."""
+        r = self._inflight.pop(slot)
+        self._free_slot_pages(slot)
+        self.state = {**self.state, "active":
+                      self.state["active"].at[slot].set(False)}
+        self._queue.appendleft(r)
+        self.evictions += 1
+
+    def _ensure_chunk_pages(self) -> None:
+        """Before each chunk, grow every live slot's page list to cover
+        all positions the chunk can write (``[pos, min(pos+chunk,
+        stop)-1]``).  Slots the pool cannot cover are PAUSED for this
+        chunk (their rows freeze entirely); if the pool starves every
+        live slot, the youngest is evicted until someone can run."""
+        if not self._inflight:
+            return
+        pos = jax.device_get(  # graftcheck: disable=host-sync
+            self.state["pos"])
+        for _ in range(len(self._inflight) + 1):
+            slots = sorted(self._inflight, key=self._admit_order.__getitem__)
+            for slot in slots:
+                # last position the chunk can consume: done fires when
+                # new_pos + 1 >= stop, so a live slot never consumes past
+                # stop - 2; gate rows are written at consumed positions
+                last = min(int(pos[slot]) + self.chunk_size - 1,
+                           int(self._host_stop[slot]) - 2)
+                need = pages_for_span(last, self.page_size)
+                sp = self._slot_pages[slot]
+                delta = need - len(sp.pages)
+                if delta <= 0:
+                    self._paused[slot] = False
+                    continue
+                fresh = self._pool.allocate(delta)
+                if fresh is None:
+                    if not self._paused[slot]:
+                        self.pause_events += 1
+                    self._paused[slot] = True
+                    continue
+                base = len(sp.pages)
+                sp.pages.extend(fresh)
+                self._page_table[slot, base: base + delta] = fresh
+                self._paused[slot] = False
+            if any(not self._paused[s] for s in self._inflight):
+                return
+            # every live slot starved: evict the most recently admitted
+            victim = max(self._inflight, key=self._admit_order.__getitem__)
+            if len(self._inflight) == 1:
+                raise RuntimeError(
+                    f"page pool too small for any progress: slot {victim} "
+                    f"needs pages beyond capacity {self._pool.capacity} "
+                    f"with nothing left to evict")
+            self._evict_slot(victim)
+
     def _harvest_done(self) -> list[Completion]:
         # two-phase fetch: one small transfer of the per-slot flags gates
         # the call (the common case is "nothing finished"); the big seq
@@ -346,6 +702,8 @@ class ServingEngine:
         act = self.state["active"]
         for i in ready:
             r = self._inflight.pop(i)
+            if self.paged:
+                self._free_slot_pages(i)
             toks = seq[i, start[i]: pos[i] + 1].copy()
             reason = "eos" if (toks.size and toks[-1] == EOS_ID) else "length"
             comp = Completion(
@@ -366,7 +724,14 @@ class ServingEngine:
         self._admit_pending()
         completed = self._harvest_done()  # instant EOS/length at admission
         if self._inflight:
-            self.state = self._decode_chunk(self._params, self.state)
+            if self.paged:
+                self._ensure_chunk_pages()
+                self.state = self._decode_chunk(
+                    self._params, self.state,
+                    jnp.asarray(self._page_table),
+                    jnp.asarray(self._paused))
+            else:
+                self.state = self._decode_chunk(self._params, self.state)
             self.chunks_run += 1
             completed += self._harvest_done()
         return completed

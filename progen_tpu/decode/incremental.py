@@ -67,7 +67,8 @@ def _update_rows(cache, update, idx, axis):
 
 def init_caches(config: ProGenConfig, batch_size: int,
                 policy: Policy | None = None,
-                decode_len: int | None = None) -> dict:
+                decode_len: int | None = None,
+                with_sgu: bool = True) -> dict:
     """Zero caches for a fresh decode (a plain pytree, scan-friendly).
 
     ``decode_len``: positions the decode will actually visit (default
@@ -76,6 +77,10 @@ def init_caches(config: ProGenConfig, batch_size: int,
     so a 200-token sample from a 4096-seq_len config allocates (and
     contracts per step) 200 rows, not 4096.  Exact because SGU row ``pos``
     is causally masked to columns ``<= pos < decode_len``.
+
+    ``with_sgu=False`` drops the per-slot gate cache entirely — the paged
+    engine keeps gate rows in a global page pool (see
+    :func:`init_gate_pool`) instead of ``batch x n_rows`` dense slabs.
     """
     c = config
     pol = policy or make_policy()
@@ -92,7 +97,24 @@ def init_caches(config: ProGenConfig, batch_size: int,
         "sgu_gate": {
             str(i): jnp.zeros((batch_size, n_rows, (c.dim * c.ff_mult) // 2), dt)
             for i in range(c.depth) if c.layer_uses_gmlp(i)
-        },
+        } if with_sgu else {},
+    }
+
+
+def init_gate_pool(config: ProGenConfig, num_pages: int, page_size: int,
+                   policy: Policy | None = None) -> dict:
+    """Zero global gate-row pool, one ``(num_pages, page_size, hidden/2)``
+    array per gMLP layer (keyed like ``sgu_gate``).  Page 0 is the
+    all-zeros NULL page and stays zero forever (reads of unowned table
+    entries land here and match the dense engine's zero-initialized
+    cache); page 1 is the write-sink DUMP page."""
+    c = config
+    pol = policy or make_policy()
+    dt = pol.compute_dtype
+    half = (c.dim * c.ff_mult) // 2
+    return {
+        str(i): jnp.zeros((num_pages, page_size, half), dt)
+        for i in range(c.depth) if c.layer_uses_gmlp(i)
     }
 
 
@@ -296,6 +318,177 @@ class ProGenDecodeStep(nn.Module):
             x = x + ff_out
             if str(i) in new["sgu_gate"]:
                 new["sgu_gate"][str(i)] = gate_cache
+
+        h = _norm(pol, name="norm_out")(x)
+        logits = _dense(cfg.num_tokens, use_bias=True, axes=("embed", "vocab"),
+                        policy=pol, name="to_logits")(h)
+        return pol.cast_to_output(logits), new
+
+
+class SGUDecodePaged(nn.Module):
+    """One-position spatial gate against the global page pool.
+
+    Identical math and parameter names to :class:`SGUDecode` (trained
+    params bind to either graph); the per-slot ``(B, n_rows, d)`` gate
+    cache is replaced by a pooled ``(num_pages, page_size, d)`` array plus
+    a per-row page table.  The freshly normed gate row is scattered into
+    the row's current page (``write_ok`` redirects paused/done/inactive
+    rows to the DUMP page), then the ragged paged contraction reproduces
+    the dense masked einsum (see ``ops/pallas_paged_attention.py``).
+    """
+
+    seq_len: int
+    dim_out: int
+    n_rows: int
+    policy: Policy
+    impl: str = "xla"
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, x, pos, pool, table, write_ok):
+        from progen_tpu.ops.pallas_paged_attention import (
+            paged_gate_mix, write_gate_row)
+
+        n = self.seq_len
+        x, gate = jnp.split(x, 2, axis=-1)
+        gate = _norm(self.policy, name="norm")(gate)
+
+        init_scale = self.eps / n
+
+        def symmetric_uniform(key, shape, dtype):
+            return jax.random.uniform(key, shape, dtype,
+                                      minval=-init_scale, maxval=init_scale)
+
+        weights = self.param("spatial_weights", symmetric_uniform, (n, n),
+                             self.policy.param_dtype)
+        biases = self.param("spatial_biases", nn.initializers.ones, (n, 1),
+                            self.policy.param_dtype)
+
+        pool = write_gate_row(pool, table, pos, gate, write_ok)
+        mixed = paged_gate_mix(weights, biases, pool, table, pos,
+                               n_rows=self.n_rows, impl=self.impl)
+        mixed = mixed.astype(x.dtype)
+
+        x = x * mixed
+        out = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
+                     policy=self.policy, name="proj_out")(x)
+        return out, pool
+
+
+class FeedForwardDecodePaged(nn.Module):
+    """gMLP feed-forward step over the paged gate pool (parameter-name
+    compatible with :class:`FeedForwardDecode`)."""
+
+    dim: int
+    seq_len: int
+    ff_mult: int
+    n_rows: int
+    shift: bool
+    policy: Policy
+    impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, pos, prev, pool, table, write_ok):
+        hidden = self.dim * self.ff_mult
+
+        normed = _norm(self.policy, name="norm")(x)
+        new_prev = normed
+        if self.shift:
+            normed = _shift_with_carry(normed, prev)
+
+        h = _dense(hidden, use_bias=True, axes=("embed", "mlp"),
+                   policy=self.policy, name="proj_in")(normed)
+        h = nn.gelu(h)
+
+        h, pool = SGUDecodePaged(
+            seq_len=self.seq_len, dim_out=hidden // 2, n_rows=self.n_rows,
+            policy=self.policy, impl=self.impl, name="sgu",
+        )(h, pos, pool, table, write_ok)
+
+        out = _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
+                     policy=self.policy, name="proj_out")(h)
+        return out, new_prev, pool
+
+
+class ProGenPagedDecodeStep(nn.Module):
+    """One paged decode step: ``(tok, pos, caches, table, write_ok) ->
+    (logits, caches)``.
+
+    Same graph as :class:`ProGenDecodeStep` except gMLP layers read/write
+    the global gate-row pool (``caches["sgu_pool"]``) through the per-row
+    page ``table`` instead of a per-slot dense cache.  ``write_ok`` masks
+    the pool scatter only — ring/carry writes are merged by liveness in
+    the engine's chunk body (a paused row must not clobber its carries
+    with a speculative step's values, since its ``pos`` does not advance).
+    """
+
+    config: ProGenConfig
+    n_rows: int
+    policy: Policy = dataclasses.field(default_factory=make_policy)
+    impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, tok, pos, caches, table, write_ok):
+        cfg, pol = self.config, self.policy
+        wsz = cfg.window_size
+        ring = 2 * wsz
+        b = tok.shape[0]
+
+        x = nn.Embed(
+            cfg.num_tokens, cfg.dim,
+            dtype=pol.compute_dtype, param_dtype=pol.param_dtype,
+            embedding_init=nn.initializers.variance_scaling(
+                1.0, "fan_in", "normal", out_axis=0),
+            name="embed",
+        )(tok)
+
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+        sin_t, cos_t = fixed_pos_embedding(cfg.seq_len, cfg.dim_head)
+        sin_row = sin_t[pos].astype(pol.compute_dtype)
+        cos_row = cos_t[pos].astype(pol.compute_dtype)
+        slot = pos % ring
+
+        s = jnp.arange(ring)[None, :]
+        p_s = pos[:, None] - jnp.mod(pos[:, None] - s, ring)
+        w_start = ((pos // wsz) * wsz)[:, None]
+        valid = p_s >= w_start - wsz  # (B, ring); see ProGenDecodeStep
+
+        new: dict[str, Any] = {
+            "attn_prev": list(caches["attn_prev"]),
+            "ff_prev": list(caches["ff_prev"]),
+            "k": list(caches["k"]),
+            "v": list(caches["v"]),
+            "sgu_pool": dict(caches["sgu_pool"]),
+        }
+
+        for i in range(cfg.depth):
+            use_gmlp = cfg.layer_uses_gmlp(i)
+            attn_out, new["attn_prev"][i], new["k"][i], new["v"][i] = (
+                LocalAttentionDecode(
+                    dim=cfg.dim, window_size=wsz, heads=cfg.heads,
+                    dim_head=cfg.dim_head, shift=cfg.shift_tokens,
+                    policy=pol, name=f"attn{i}",
+                )(x, sin_row, cos_row, slot, valid,
+                  caches["attn_prev"][i], caches["k"][i], caches["v"][i])
+            )
+            x = x + attn_out
+
+            if use_gmlp:
+                ff_out, new["ff_prev"][i], new["sgu_pool"][str(i)] = (
+                    FeedForwardDecodePaged(
+                        dim=cfg.dim, seq_len=cfg.seq_len, ff_mult=cfg.ff_mult,
+                        n_rows=self.n_rows, shift=cfg.shift_tokens,
+                        policy=pol, impl=self.impl, name=f"ff{i}",
+                    )(x, pos, caches["ff_prev"][i],
+                      caches["sgu_pool"][str(i)], table, write_ok)
+                )
+            else:
+                ff_out, new["ff_prev"][i], _ = FeedForwardDecode(
+                    dim=cfg.dim, seq_len=cfg.seq_len, ff_mult=cfg.ff_mult,
+                    glu=cfg.ff_glu, use_sgu=False,
+                    shift=cfg.shift_tokens, policy=pol, name=f"ff{i}",
+                )(x, pos, caches["ff_prev"][i], jnp.zeros(()))
+            x = x + ff_out
 
         h = _norm(pol, name="norm_out")(x)
         logits = _dense(cfg.num_tokens, use_bias=True, axes=("embed", "vocab"),

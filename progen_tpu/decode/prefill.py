@@ -83,12 +83,19 @@ def _constrain_caches(caches, mesh: Mesh, strategies: Sequence[str]):
     wsc = jax.lax.with_sharding_constraint
     kv = NamedSharding(mesh, PartitionSpec(None, "tensor", None, None))
     gate = NamedSharding(mesh, PartitionSpec(None, None, "tensor"))
-    return {
+    out = {
         **caches,
         "k": [wsc(x, kv) for x in caches["k"]],
         "v": [wsc(x, kv) for x in caches["v"]],
-        "sgu_gate": {k: wsc(v, gate) for k, v in caches["sgu_gate"].items()},
     }
+    if caches.get("sgu_gate"):
+        out["sgu_gate"] = {k: wsc(v, gate) for k, v in
+                           caches["sgu_gate"].items()}
+    if caches.get("sgu_pool"):
+        # pooled gate rows shard on the channel half like the dense cache
+        out["sgu_pool"] = {k: wsc(v, gate) for k, v in
+                           caches["sgu_pool"].items()}
+    return out
 
 
 def _take_row(x, idx):
@@ -98,9 +105,15 @@ def _take_row(x, idx):
 
 
 def harvest_caches(config: ProGenConfig, sown: dict, lengths, policy: Policy,
-                   decode_len: int) -> dict:
+                   decode_len: int, with_sgu: bool = True) -> dict:
     """Build decode caches from the parallel forward's sown "cache"
-    collection, per-row masked to ``lengths``."""
+    collection, per-row masked to ``lengths``.
+
+    ``with_sgu=False`` skips the dense per-slot gate cache (the paged
+    engine scatters gate rows straight into the global page pool via
+    :func:`harvest_gate_pages` instead — no ``(B, n_rows, half)`` slab is
+    ever materialized).
+    """
     c = config
     pol = policy
     ring = 2 * c.window_size
@@ -132,7 +145,7 @@ def harvest_caches(config: ProGenConfig, sown: dict, lengths, policy: Policy,
         caches["k"].append(jnp.where(m, k_ring, 0).astype(pol.compute_dtype))
         caches["v"].append(jnp.where(m, v_ring, 0).astype(pol.compute_dtype))
 
-        if c.layer_uses_gmlp(i):
+        if c.layer_uses_gmlp(i) and with_sgu:
             gate = ff["sgu"]["gate"][0]  # (B, P_pad, hidden/2) normed
             b, p_pad, half = gate.shape
             rows = jnp.zeros((b, n_rows, half), pol.compute_dtype)
@@ -142,6 +155,44 @@ def harvest_caches(config: ProGenConfig, sown: dict, lengths, policy: Policy,
                 jnp.where(keep, gate[:, :upto], 0).astype(pol.compute_dtype))
             caches["sgu_gate"][str(i)] = rows
     return caches
+
+
+def harvest_gate_pages(config: ProGenConfig, sown: dict, lengths, pool: dict,
+                       wtable, policy: Policy) -> dict:
+    """Scatter the prefill's sown gate rows straight into the page pool.
+
+    The paged engine's admission path: instead of building a contiguous
+    ``(B, n_rows, half)`` gate cache, each prime row ``i`` of request
+    ``b`` is scattered to pool page ``wtable[b, i // page_size]`` at
+    offset ``i % page_size``.  ``wtable`` is the WRITE table: it names the
+    request's freshly allocated private pages and holds ``DUMP_PAGE`` for
+    pages it must not write — prefix-cache hits (read-only, filled by the
+    first request that computed them) and unowned tail entries.  Pad rows
+    (``i >= lengths[b]``) are dumped too, so the scatter stays dense.
+    """
+    from progen_tpu.decode.paging import DUMP_PAGE
+
+    c = config
+    new_pool = dict(pool)
+    for i in range(c.depth):
+        if not c.layer_uses_gmlp(i):
+            continue
+        gate = sown[f"ff{i}"]["sgu"]["gate"][0]  # (B, P_pad, half) normed
+        b, p_pad, half = gate.shape
+        layer_pool = pool[str(i)]  # (num_pages, page_size, half)
+        page_size = layer_pool.shape[1]
+        pages_per_row = wtable.shape[1]
+        rows = jnp.arange(p_pad)
+        # the window-aligned P_pad can overshoot the table span; clamp the
+        # page index — every overshooting row is >= lengths and dumped
+        page_idx = jnp.minimum(rows // page_size, pages_per_row - 1)
+        tgt = wtable[:, page_idx]  # (B, P_pad)
+        tgt = jnp.where(rows[None, :] < lengths[:, None], tgt, DUMP_PAGE)
+        off = jnp.broadcast_to((rows % page_size)[None, :], (b, p_pad))
+        new_pool[str(i)] = layer_pool.at[
+            tgt.reshape(-1), off.reshape(-1)
+        ].set(gate.astype(layer_pool.dtype).reshape(-1, half))
+    return new_pool
 
 
 def make_prefiller(config: ProGenConfig, policy: Policy | None = None,

@@ -1,0 +1,188 @@
+"""Ragged paged decode kernel: per-row page-table walk over pooled state.
+
+This is the decode-side companion of the paged serving subsystem
+(``decode/paging.py``).  In this architecture the attention k/v cache is
+already an O(2·window) ring per slot — the per-token state that actually
+scales with request length (the thing a "paged KV cache" must page) is
+the **SGU gate cache**: the spatial gating unit attends over ALL previous
+token rows through the learned causal ``(n, n)`` weight, exactly the
+all-past-tokens contraction that Ragged Paged Attention (PAPERS.md)
+pages.  So the pooled resource here is gate rows and the ragged kernel
+computes, for batch row ``b`` at position ``pos_b``::
+
+    mixed[b] = sum_{i <= pos_b} W[pos_b, i] * pool[table[b, i // ps], i % ps]
+               + bias[pos_b]
+
+where ``pool`` is the global page pool ``(num_pages, page_size, d)`` and
+``table`` is the per-row page table ``(B, pages_per_row)``.  Each batch
+row walks ONLY its own pages: the grid is ``(B, pages_per_row)``, the
+page axis is innermost (consecutive visits to the same output row, the
+accumulation contract from ``pallas_sgu.py``), and pages past the row's
+position are skipped entirely (``@pl.when`` — a short request touches
+``pos // ps + 1`` pages, not the table width).  Bit-for-bit discipline:
+
+* the per-page partial products accumulate in an f32 VMEM scratch;
+* ``pos`` and the page table ride in as SCALAR-PREFETCH operands
+  (``pltpu.PrefetchScalarGridSpec``): the index maps that choose the
+  weight-row block (``pos_ref[b]``) and the pool page
+  (``table_ref[b, p]``) are integer lookups into prefetched SMEM —
+  no gather materialization, no float work on the scalar core;
+* unowned table entries point at the all-zeros ``NULL_PAGE`` so reading
+  them is harmless, and the in-kernel causal mask zeroes columns past
+  ``pos`` so stale rows in reused pages contribute exact ±0.
+
+The XLA fallback (``impl="xla"``) is a gather + the SAME masked einsum
+the dense decode path uses, sliced to the dense row count — on CPU it is
+bitwise identical to the fixed-slot engine's contraction, which is what
+the engine-parity tier-1 tests pin.  ``interpret=None`` auto-selects the
+Pallas interpreter off-TPU, mirroring ``pallas_sgu.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from progen_tpu.decode.paging import DUMP_PAGE, NULL_PAGE
+
+
+def _mix_kernel(pos_ref, table_ref, w_ref, pool_ref, bias_ref, o_ref,
+                acc_ref, *, page_size, pages_per_row):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[b]
+    # pages strictly past the row's position hold no live rows: skip the
+    # fetch-multiply entirely (ragged walk — work scales with pos, not
+    # with the table width)
+    @pl.when(p <= pos // page_size)
+    def _accumulate():
+        col = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        w = jnp.where(col <= pos, w_ref[...].astype(jnp.float32), 0.0)
+        acc_ref[...] += jax.lax.dot_general(
+            w, pool_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(p == pages_per_row - 1)
+    def _epilogue():
+        o_ref[...] = acc_ref[...] + bias_ref[...].astype(jnp.float32)
+
+
+def _pallas_mix(weights, biases, pool, table, pos, *, interpret):
+    batch, pages_per_row = table.shape
+    _, page_size, d = pool.shape
+    n = weights.shape[0]
+    span = pages_per_row * page_size
+    if span > n:
+        # the last page may run past the (n, n) weight square; pad the
+        # column axis so every (1, page_size) block is in-bounds (the
+        # causal mask kills the padded columns — and their pool rows are
+        # real page rows, so the product is exact zero, not garbage)
+        weights = jnp.pad(weights, ((0, 0), (0, span - n)))
+    # biases come in as (n, 1) column vectors (ops/sgu.py layout)
+    biases = biases.reshape(n, 1).T  # (1, n) -> block (1, 1) at [0, pos]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, pages_per_row),
+        in_specs=[
+            # weight row pos_b, column block p  (integer-only index maps:
+            # scalar-prefetch refs indexed by grid coordinates)
+            pl.BlockSpec((1, page_size),
+                         lambda b, p, pos_ref, table_ref: (pos_ref[b], p)),
+            # the pool page this row's table names for block p
+            pl.BlockSpec((1, page_size, d),
+                         lambda b, p, pos_ref, table_ref:
+                         (table_ref[b, p], 0, 0)),
+            pl.BlockSpec((1, 1),
+                         lambda b, p, pos_ref, table_ref: (0, pos_ref[b])),
+        ],
+        out_specs=pl.BlockSpec((1, d),
+                               lambda b, p, pos_ref, table_ref: (b, 0)),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+    )
+    kernel = functools.partial(_mix_kernel, page_size=page_size,
+                               pages_per_row=pages_per_row)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, d), jnp.float32),
+        interpret=interpret,
+    )(pos.astype(jnp.int32), table.astype(jnp.int32), weights, pool, biases)
+
+
+def _xla_mix(weights, biases, pool, table, pos, *, n_rows):
+    """Gather fallback, bit-matched to the dense decode contraction.
+
+    Gathers each row's pages, slices to exactly ``n_rows`` (the dense
+    engine's cache length) and runs the IDENTICAL masked f32 einsum the
+    dense ``SGUDecode`` uses — stale rows in reused pages meet exact-zero
+    causal weights, so the sums are bitwise those of the dense engine.
+    """
+    batch, pages_per_row = table.shape
+    _, page_size, d = pool.shape
+    rows = pool[table].reshape(batch, pages_per_row * page_size, d)
+    rows = rows[:, :n_rows]
+    w_rows = weights.astype(jnp.float32)[pos][:, :n_rows]
+    causal = jnp.arange(n_rows)[None, :] <= pos[:, None]
+    w_rows = w_rows * causal.astype(jnp.float32)
+    mixed = jnp.einsum("bnd,bn->bd", rows.astype(jnp.float32), w_rows,
+                       preferred_element_type=jnp.float32)
+    bias_m = biases.astype(jnp.float32)[pos]  # (B, 1), dense layout
+    return mixed + bias_m
+
+
+def paged_gate_mix(weights, biases, pool, table, pos, *, n_rows,
+                   impl="xla", interpret=None):
+    """Ragged paged spatial-gate contraction.
+
+    Args:
+      weights: ``(n, n)`` learned causal spatial weights.
+      biases: ``(n, 1)`` spatial biases.
+      pool: ``(num_pages, page_size, d)`` global gate-row pool.
+      table: ``(B, pages_per_row)`` int32 page table (NULL_PAGE for
+        unowned entries).
+      pos: ``(B,)`` int32 current positions.
+      n_rows: dense cache length the XLA path slices to (the fixed-slot
+        engine's ``decode_len``) — keeps the fallback bit-identical to
+        the dense contraction.
+      impl: ``"xla"`` (gather fallback) or ``"pallas"`` (ragged kernel).
+      interpret: force/disable the Pallas interpreter; None auto-selects
+        it off-TPU.
+
+    Returns:
+      ``(B, d)`` f32 ``mixed + bias`` (caller casts to the compute dtype
+      and applies the gate multiply, matching dense ``SGUDecode``).
+    """
+    if impl == "xla":
+        return _xla_mix(weights, biases, pool, table, pos, n_rows=n_rows)
+    if impl != "pallas":
+        raise ValueError(f"unknown paged gate impl: {impl!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _pallas_mix(weights, biases, pool, table, pos,
+                       interpret=interpret)
+
+
+def write_gate_row(pool, table, pos, gate, write_ok):
+    """Scatter each live row's freshly computed gate into its page.
+
+    Rows with ``write_ok=False`` (done / inactive / paused) and rows
+    whose table entry is still NULL are redirected to the write-sink
+    ``DUMP_PAGE`` — the scatter stays dense and unpredicated, and the
+    zero page plus read-only shared pages are never clobbered.
+    """
+    page_size = pool.shape[1]
+    tgt = jnp.take_along_axis(table, (pos // page_size)[:, None],
+                              axis=1)[:, 0]
+    tgt = jnp.where(write_ok & (tgt != NULL_PAGE), tgt, DUMP_PAGE)
+    return pool.at[tgt, pos % page_size].set(gate)

@@ -364,3 +364,104 @@ def check_fits(plan_: MemoryPlan, hbm_bytes: int | None,
         f"{plan_.report()}\n"
         "try: " + "; ".join(suggestions or ["a bigger mesh"])
     )
+
+
+# --------------------------------------------------------------- serving side
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingMemoryPlan:
+    """Predicted HBM for the ServingEngine's per-request decode state.
+
+    The pageable resource in this architecture is the SGU gate cache —
+    the one buffer that scales with ``max_len`` per slot (the attention
+    k/v ring is a fixed O(2·window) and the carries are O(dim)).  The
+    fixed-slot engine allocates ``gate_bytes_per_slot`` for every slot up
+    front; paged mode replaces ``num_slots * gate_bytes_per_slot`` with
+    ``pool_bytes`` (+ a tiny int32 page table), so the paged-vs-dense
+    comparison at equal budget is ``pool_bytes`` vs
+    ``num_slots * gate_bytes_per_slot``.
+    """
+
+    ring_bytes_per_slot: int
+    carry_bytes_per_slot: int
+    seq_bytes_per_slot: int
+    gate_bytes_per_slot: int  # dense mode only (0 when paged)
+    pool_bytes: int           # paged mode only (0 when dense)
+    table_bytes: int
+    num_slots: int
+
+    @property
+    def fixed_bytes_per_slot(self) -> int:
+        return (self.ring_bytes_per_slot + self.carry_bytes_per_slot
+                + self.seq_bytes_per_slot)
+
+    @property
+    def pageable_bytes(self) -> int:
+        """The budgeted resource: dense per-slot gate slabs or the pool."""
+        return self.num_slots * self.gate_bytes_per_slot + self.pool_bytes
+
+    @property
+    def total_bytes(self) -> int:
+        return (self.num_slots * (self.fixed_bytes_per_slot
+                                  + self.gate_bytes_per_slot)
+                + self.pool_bytes + self.table_bytes)
+
+
+def gate_row_bytes(cfg, mixed_precision: bool = True) -> int:
+    """Bytes of ONE token row of SGU gate state across all gMLP layers —
+    the per-token unit both the dense slab and the page pool are made of."""
+    act = 2 if mixed_precision else 4
+    gmlp_layers = sum(1 for i in range(cfg.depth) if cfg.layer_uses_gmlp(i))
+    half = (cfg.dim * cfg.ff_mult) // 2
+    return gmlp_layers * half * act
+
+
+def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
+                 mixed_precision: bool = True, paged: bool = False,
+                 page_size: int = 16,
+                 num_pages: int | None = None) -> ServingMemoryPlan:
+    """HBM accounting for a ServingEngine configuration (dense or paged).
+
+    Mirrors ``decode/engine.py``'s state layout: k/v rings + carries +
+    seq per slot always; per-slot ``(max_len, half)`` gate slabs in dense
+    mode, the global ``(num_pages, page_size, half)`` pool (per gMLP
+    layer) in paged mode.  ``num_pages`` defaults like the engine's
+    (full budget: every slot can reach ``max_len``)."""
+    act = 2 if mixed_precision else 4
+    L = min(max_len or cfg.seq_len, cfg.seq_len)
+    ring = 2 * cfg.window_size
+    ring_b = cfg.depth * 2 * cfg.heads * ring * cfg.dim_head * act
+    carry_b = cfg.depth * 2 * cfg.dim * act
+    seq_b = L * 4
+    row_b = gate_row_bytes(cfg, mixed_precision)
+    pages_per_row = -(-L // page_size)
+    if paged:
+        if num_pages is None:
+            num_pages = 2 + num_slots * pages_per_row
+        pool_b = num_pages * page_size * row_b
+        gate_b = 0
+        table_b = num_slots * pages_per_row * 4
+    else:
+        pool_b = 0
+        gate_b = L * row_b
+        table_b = 0
+    return ServingMemoryPlan(
+        ring_bytes_per_slot=ring_b,
+        carry_bytes_per_slot=carry_b,
+        seq_bytes_per_slot=seq_b,
+        gate_bytes_per_slot=gate_b,
+        pool_bytes=pool_b,
+        table_bytes=table_b,
+        num_slots=num_slots,
+    )
+
+
+def equal_budget_pages(cfg, *, dense_slots: int, max_len: int,
+                       page_size: int = 16) -> int:
+    """Pool size (total pages, incl. the 2 reserved) whose gate-row bytes
+    match what ``dense_slots`` fixed slots would pin: the equal-modeled-
+    HBM-budget comparison from the serving benchmark.  The row byte size
+    cancels, so this is just ``dense_slots * max_len`` token rows worth
+    of pages."""
+    return max(3, (dense_slots * max_len) // page_size)

@@ -38,14 +38,30 @@ honor_env_platforms()
 @click.option("--slots", default=8, help="engine: max concurrent requests")
 @click.option("--chunk", default=32, help="engine: decode steps per device "
                                           "program between refill points")
+@click.option("--paged", is_flag=True,
+              help="engine: paged SGU gate cache — global page pool + "
+                   "per-request page tables instead of per-slot max_len "
+                   "slabs (docs/SERVING.md); greedy outputs are "
+                   "bit-identical to the fixed-slot engine")
+@click.option("--page_size", default=16, help="engine: token rows per page "
+                                              "(with --paged)")
+@click.option("--compile_cache", default=None, metavar="DIR",
+              help="JAX persistent compilation cache directory ('0' "
+                   "disables); overrides PROGEN_COMPILE_CACHE, default "
+                   "~/.cache/progen_tpu/xla")
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
-         seq_len, mesh_spec, strategies, serve, slots, chunk):
+         seq_len, mesh_spec, strategies, serve, slots, chunk, paged,
+         page_size, compile_cache):
+    import os
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from progen_tpu.core.cache import enable_compilation_cache
 
+    if compile_cache is not None:
+        os.environ["PROGEN_COMPILE_CACHE"] = compile_cache
     enable_compilation_cache()  # the decode scan is minutes of compile
 
     from progen_tpu.checkpoint import CheckpointStore, abstract_params_like
@@ -102,6 +118,7 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
         engine = ServingEngine(
             model_config, {"params": params}, policy=policy,
             num_slots=slots, chunk_size=chunk, max_len=seq_len,
+            paged=paged, page_size=page_size,
             mesh=mesh, strategies=strategy_list, params_shardings=param_sh)
         for i, p in enumerate(primes):
             toks = [0] + encode_tokens(p)  # BOS-prefixed, like add_bos

@@ -21,6 +21,14 @@ JAX_PLATFORMS=cpu python -m pytest \
     tests/test_decode.py tests/test_observe.py \
     -q -m 'not slow' -p no:cacheprovider
 
+echo "== paged-serving smoke =="
+# tiny paged run on CPU: page pool + ragged paged mix + paged engine end
+# to end, one parseable JSON record (full comparison: benchmarks/paged.md)
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 4 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --paged --page-size 8
+
 echo "== superstep quick-bench smoke =="
 # tiny-shape K-sweep on CPU: proves the fused dispatch path runs end to
 # end and emits parseable JSON (full sweep: benchmarks/superstep.md)
