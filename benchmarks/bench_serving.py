@@ -23,6 +23,19 @@ sizes the pool to the same modeled gate-row HBM as a fixed-slot engine
 with N slots, for equal-budget concurrency comparisons — the record's
 ``max_in_flight`` and ``gate_hbm_bytes`` fields carry the comparison
 (see benchmarks/paged.md).
+
+``--chaos`` arms the fault injector with ``--faults`` (a
+``PROGEN_FAULTS``-syntax plan hitting the serving points) and records a
+``serving_chaos`` line instead: goodput (tokens/sec over OK completions
+only), latency percentiles over OK completions, the fraction finishing
+within ``--slo`` seconds, and the engine's robustness counters (sheds,
+contained faults, kernel fallbacks).  ``--verify`` additionally re-runs
+the same request set fault-free and asserts every non-shed chaos
+completion is token-identical (per-request seed determinism), then
+exercises snapshot -> restore -> replay and asserts the SAME parity —
+the replay-correctness smoke ``tools/check.sh`` gates on.  ``--out``
+appends the record to a JSONL file (``benchmarks/chaos.jsonl`` by
+convention) in addition to stdout.
 """
 
 from __future__ import annotations
@@ -77,6 +90,40 @@ def main() -> None:
                          "to the SAME modeled gate-cache HBM as a "
                          "fixed-slot engine with this many slots "
                          "(equal-budget comparison)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm the fault injector with --faults and record "
+                         "a serving_chaos line (goodput, within-SLO "
+                         "fraction, robustness counters)")
+    ap.add_argument("--faults",
+                    default="serve.admit:io_error:at=2;"
+                            "serve.prefill:unavailable:at=2;"
+                            "serve.decode_chunk:io_error:at=3;"
+                            "serve.harvest:io_error:at=2",
+                    help="fault plan (PROGEN_FAULTS syntax) for --chaos; "
+                         "the default hits four serving points once each "
+                         "with transient faults")
+    ap.add_argument("--faults-seed", type=int, default=0)
+    ap.add_argument("--ttl", type=float, default=None,
+                    help="per-request time-to-live in seconds; expired "
+                         "requests are shed as typed completions")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bounded submit queue; overflow is shed per "
+                         "--shed-policy")
+    ap.add_argument("--shed-policy", choices=("reject", "shed-oldest"),
+                    default="reject")
+    ap.add_argument("--slo", type=float, default=10.0,
+                    help="latency SLO in seconds for the within_slo_frac "
+                         "metric (over OK completions)")
+    ap.add_argument("--aot-warmup", action="store_true",
+                    help="warm up via AOT lower().compile() over the "
+                         "(prefill bucket, chunk) grid instead of two "
+                         "sacrificial requests")
+    ap.add_argument("--verify", action="store_true",
+                    help="after the measured run: fault-free rerun + "
+                         "token-identity assert on non-shed completions, "
+                         "then snapshot/restore replay-parity assert")
+    ap.add_argument("--out", metavar="FILE", default=None,
+                    help="also append the record to this JSONL file")
     ap.add_argument("--compile_cache", metavar="DIR", default=None,
                     help="JAX persistent compilation cache dir ('0' "
                          "disables); overrides PROGEN_COMPILE_CACHE")
@@ -96,6 +143,7 @@ def main() -> None:
     from progen_tpu.models import ProGen
     from progen_tpu.models.configs import CONFIGS
     from progen_tpu.parallel import unbox
+    from progen_tpu.resilience import faults
 
     cfg = CONFIGS[args.config]
     policy = make_policy(True)
@@ -107,14 +155,19 @@ def main() -> None:
     pmax = min(args.prime_max, cfg.seq_len - args.max_new - 1)
     pmin = min(args.prime_min, pmax)
 
-    def make_request(uid: int, submit_time: float) -> Request:
-        p = int(rng.integers(pmin, pmax + 1))
+    # request specs are FIXED up front so a --verify fault-free rerun
+    # replays the exact same (tokens, seed) set — per-request seed
+    # determinism then makes token identity a hard assert, not a hope
+    specs = [rng.integers(1, cfg.num_tokens,
+                          int(rng.integers(pmin, pmax + 1))).tolist()
+             for _ in range(args.requests)]
+
+    def make_request(uid: int, submit_time: float,
+                     ttl: float | None = None) -> Request:
         return Request(
-            uid=uid,
-            tokens=rng.integers(1, cfg.num_tokens, p).tolist(),
-            max_new_tokens=args.max_new,
+            uid=uid, tokens=specs[uid], max_new_tokens=args.max_new,
             top_k=25, temperature=1.0, seed=args.seed + uid,
-            submit_time=submit_time,
+            submit_time=submit_time, ttl=ttl,
         )
 
     max_len = args.max_len or min(cfg.seq_len, pmax + args.max_new + 1)
@@ -129,15 +182,38 @@ def main() -> None:
         paged=True, page_size=args.page_size, num_pages=num_pages,
         paged_impl=args.paged_impl, prefix_cache=not args.no_prefix_cache,
     ) if args.paged else {}
-    engine = ServingEngine(cfg, params, policy=policy,
-                           num_slots=args.slots, chunk_size=args.chunk,
-                           max_len=max_len, **paged_kwargs)
 
-    # warmup: compile the admission + chunk programs off the clock
-    for i in range(min(2, args.slots)):
-        engine.submit(make_request(10_000_000 + i, time.perf_counter()))
-    engine.run_until_idle()
-    engine.completions.clear()
+    def mk_engine(*, robust: bool) -> ServingEngine:
+        kw = dict(paged_kwargs)
+        if robust:
+            kw.update(max_queue=args.max_queue,
+                      shed_policy=args.shed_policy)
+        return ServingEngine(cfg, params, policy=policy,
+                             num_slots=args.slots, chunk_size=args.chunk,
+                             max_len=max_len, **kw)
+
+    engine = mk_engine(robust=True)
+
+    # warmup: compile the admission + chunk programs off the clock — AOT
+    # over the whole (bucket, chunk) grid, or two sacrificial requests
+    # (drawn from a SEPARATE rng so the measured specs stay fixed)
+    if args.aot_warmup:
+        stats = engine.aot_warmup(max_prime=pmax)
+        print(f"aot warmup: {stats['programs']} programs in "
+              f"{stats['seconds']:.1f}s", file=sys.stderr)
+    else:
+        wrng = np.random.default_rng(args.seed + 999)
+        for i in range(min(2, args.slots)):
+            engine.submit(Request(
+                uid=10_000_000 + i,
+                tokens=wrng.integers(1, cfg.num_tokens, pmax).tolist(),
+                max_new_tokens=args.max_new, top_k=25, temperature=1.0,
+                seed=args.seed, submit_time=time.perf_counter()))
+        engine.run_until_idle()
+        engine.completions.clear()
+
+    if args.chaos:
+        faults.configure(args.faults, seed=args.faults_seed)
 
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
                                          size=args.requests))
@@ -148,9 +224,12 @@ def main() -> None:
     while len(done) < args.requests:
         now = time.perf_counter() - t0
         while nxt < args.requests and arrivals[nxt] <= now:
-            engine.submit(make_request(nxt, t0 + arrivals[nxt]))
+            engine.submit(make_request(nxt, t0 + arrivals[nxt],
+                                       ttl=args.ttl))
             nxt += 1
-        if engine.pending == 0 and engine.num_active == 0:
+        if not engine.has_work:
+            if nxt >= args.requests:
+                break  # nothing queued, nothing arriving: all accounted
             # idle before the next arrival: sleep the gap (real servers
             # block on the queue here)
             time.sleep(max(0.0, arrivals[nxt] - (time.perf_counter() - t0)))
@@ -161,16 +240,20 @@ def main() -> None:
         max_in_flight = max(max_in_flight,
                             engine.num_active + len(done_now))
     wall = time.perf_counter() - t0
+    counters = engine.robustness_counters()  # before the injector disarms
+    if args.chaos:
+        faults.configure("")
 
-    latencies = sorted(c.latency for c in done)
-    gen_tokens = int(sum(len(c.tokens) for c in done))
+    ok = [c for c in done if c.ok]
+    latencies = sorted(c.latency for c in ok) or [0.0]
+    gen_tokens = int(sum(len(c.tokens) for c in ok))
     from progen_tpu.train.memory import serving_plan
 
     plan = serving_plan(cfg, num_slots=args.slots, max_len=max_len,
                         paged=args.paged, page_size=args.page_size,
                         num_pages=num_pages)
     record = {
-        "metric": "serving",
+        "metric": "serving_chaos" if args.chaos else "serving",
         "config": args.config,
         "requests": args.requests,
         "rate_per_sec": args.rate,
@@ -202,7 +285,62 @@ def main() -> None:
             "evictions": engine.evictions,
             "pause_events": engine.pause_events,
         })
-    print(json.dumps(record), flush=True)
+    if args.chaos:
+        record.update({
+            "faults_plan": args.faults,
+            "faults_seed": args.faults_seed,
+            "slo_s": args.slo,
+            "ok_requests": len(ok),
+            "goodput_tokens_per_sec": record.pop("tokens_per_sec"),
+            "within_slo_frac": round(
+                sum(1 for c in ok if c.latency <= args.slo)
+                / max(1, len(ok)), 3),
+            "robustness": counters,
+        })
+
+    if args.verify:
+        _verify(mk_engine, make_request, done, args)
+        record["verified"] = True
+
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(line + "\n")
+
+
+def _verify(mk_engine, make_request, done, args) -> None:
+    """Fault-free rerun + snapshot/restore replay, both asserted
+    token-identical to the measured run's non-shed completions."""
+    import time
+
+    clean_eng = mk_engine(robust=False)
+    for uid in range(args.requests):
+        clean_eng.submit(make_request(uid, time.perf_counter()))
+    clean = {c.uid: c.tokens.tolist() for c in clean_eng.run_until_idle()}
+
+    mismatched = [c.uid for c in done
+                  if c.ok and c.tokens.tolist() != clean[c.uid]]
+    assert not mismatched, (
+        f"chaos run diverged from fault-free run for uids {mismatched}")
+
+    # snapshot mid-run, replay on a FRESH engine, assert token identity
+    snap_eng = mk_engine(robust=False)
+    for uid in range(args.requests):
+        snap_eng.submit(make_request(uid, time.perf_counter()))
+    for _ in range(2):
+        snap_eng.step()
+    snap = snap_eng.snapshot()
+    pre = {c.uid: c.tokens.tolist() for c in snap_eng.completions}
+
+    replay_eng = mk_engine(robust=False)
+    replay_eng.restore(snap)
+    post = {c.uid: c.tokens.tolist() for c in replay_eng.run_until_idle()}
+    merged = {**pre, **post}
+    assert merged == clean, (
+        "snapshot -> restore -> replay diverged from the straight run")
+    print("verify: chaos token-identity and snapshot replay parity OK",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

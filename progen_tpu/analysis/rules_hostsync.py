@@ -46,14 +46,20 @@ HOT_ZONES: tuple[Zone, ...] = (
     Zone(
         r"decode/engine\.py$",
         r"ServingEngine\.(step|submit|run_until_idle|_admit_pending"
-        r"|_admit_pending_paged|_plan_slot_pages|_free_slot_pages"
-        r"|_evict_slot|_ensure_chunk_pages|_harvest_done)$",
+        r"|_admit_pending_dense|_admit_pending_paged|_plan_slot_pages"
+        r"|_free_slot_pages|_evict_slot|_ensure_chunk_pages|_harvest_done"
+        r"|drain|snapshot|restore|has_work|_shed_expired|_shed|_guard"
+        r"|_dispatch_chunk|_fail_inflight|_activate_xla_fallback"
+        r"|_drain_pending|robustness_counters)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
                    "_admit_order", "_admit_seq", "page_size",
                    "pages_per_row", "paged", "chunk_size", "evictions",
-                   "pause_events", "prefix_hits"}),
+                   "pause_events", "prefix_hits", "robust", "_pending",
+                   "_draining", "_aot", "_compiled_keys", "_defer_streak",
+                   "fault_retries", "max_queue", "shed_policy",
+                   "paged_impl", "_watchdog"}),
     ),
     # the page pool is pure host bookkeeping between dispatches: nothing
     # in it may touch a device value, so every sync call is a finding

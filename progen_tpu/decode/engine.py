@@ -37,12 +37,29 @@ EOS convention: primes are served verbatim (no BOS prepend); generation
 stops at the first sampled pad/EOS token (id 0) or after
 ``max_new_tokens``.  The reference's "second zero" truncation is a
 sampler-level concern; a serving request's prime is explicit.
+
+Robustness (docs/RESILIENCE.md): every serving phase runs behind a named
+fault-injection point (``serve.submit`` / ``serve.admit`` /
+``serve.prefill`` / ``serve.decode_chunk`` / ``serve.harvest`` /
+``serve.page_alloc``).  Because each phase is FUNCTIONAL — state in,
+state out, ``self.state`` replaced only on success — a transient fault is
+contained by re-running the failed dispatch in place; a fatal fault sheds
+only the requests whose work was lost, as typed completions
+(``FAILED_FAULT``) rather than exceptions.  Requests carry optional
+deadlines (``deadline``/``ttl`` → ``SHED_DEADLINE``), admission is
+bounded (``max_queue`` → ``SHED_QUEUE_FULL``), and the lifecycle is
+crash-safe: ``snapshot()`` persists host-side request state only (prompt,
+sampling params, seeds — never device caches), and ``restore()`` +
+seed-determinism replays in-flight requests token-identically
+(:func:`run_with_restarts`).
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
+import os
 import time
 from collections import deque
 from functools import partial
@@ -55,6 +72,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.observe.robustness import RobustnessCounters
+from progen_tpu.resilience import faults
+from progen_tpu.resilience.retry import RetryError, default_classifier
+from progen_tpu.resilience.watchdog import Watchdog
 from progen_tpu.decode.incremental import (
     ProGenDecodeStep,
     ProGenPagedDecodeStep,
@@ -75,11 +96,33 @@ from progen_tpu.decode.prefill import (
     harvest_caches,
     harvest_gate_pages,
     pad_prime_length,
+    prime_buckets,
 )
 from progen_tpu.decode.sampler import gumbel_topk_sample_batched
 from progen_tpu.models.progen import ProGen, ProGenConfig
 
 EOS_ID = 0
+
+# typed Completion.status values — sheds are COMPLETIONS, not exceptions,
+# so callbacks/benchmarks see every request exactly once either way
+STATUS_OK = "ok"
+SHED_QUEUE_FULL = "shed_queue_full"
+SHED_DEADLINE = "shed_deadline"
+FAILED_FAULT = "failed_fault"
+
+# consecutive rounds a phase may defer (fatal-fault containment) before
+# the engine concludes the fault is permanent and gives up
+_MAX_DEFER_STREAK = 16
+
+
+class _ContainedFault(Exception):
+    """Internal: a phase failed NON-transiently; the caller sheds the
+    affected requests per its containment rule.  ``__cause__`` is the
+    underlying fault."""
+
+    def __init__(self, point: str):
+        super().__init__(f"non-transient fault at {point}")
+        self.point = point
 
 
 @dataclasses.dataclass
@@ -89,6 +132,12 @@ class Request:
     ``tokens``: the prime, served verbatim (encode + add BOS upstream if
     desired); must be non-empty and leave room for at least one new
     token.  ``top_k=None`` disables top-k; ``temperature=0`` is greedy.
+
+    SLO knobs: ``deadline`` is an absolute ``time.perf_counter()``
+    instant, ``ttl`` a budget in seconds from ``submit_time``
+    (``deadline`` wins when both are set).  Past it the request is shed
+    with a ``SHED_DEADLINE`` completion — queued requests before they
+    cost a prefill, in-flight ones mid-decode with their partial tokens.
     """
 
     uid: Any
@@ -97,6 +146,8 @@ class Request:
     top_k: int | None = None
     temperature: float = 1.0
     seed: int = 0
+    deadline: float | None = None
+    ttl: float | None = None
     on_complete: Callable[["Completion"], None] | None = None
     submit_time: float = dataclasses.field(default_factory=time.perf_counter)
 
@@ -104,18 +155,30 @@ class Request:
 @dataclasses.dataclass
 class Completion:
     """A finished request: ``tokens`` is the generated tail only (EOS
-    included when the model emitted one)."""
+    included when the model emitted one).
+
+    ``status`` is ``STATUS_OK`` for served requests (``finish_reason`` is
+    ``"eos"``/``"length"``) or a shed type (``SHED_QUEUE_FULL`` /
+    ``SHED_DEADLINE`` / ``FAILED_FAULT``, mirrored into
+    ``finish_reason``) — load shedding produces a COMPLETION, never an
+    exception, so every submitted request is answered exactly once.
+    """
 
     uid: Any
     prime: np.ndarray
     tokens: np.ndarray
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | shed status
     submit_time: float
     finish_time: float
+    status: str = STATUS_OK
 
     @property
     def latency(self) -> float:
         return self.finish_time - self.submit_time
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
 
 
 class ServingEngine:
@@ -143,6 +206,15 @@ class ServingEngine:
     ``num_pages`` counts pool pages incl. the 2 reserved ones (default:
     full budget — every slot can reach ``max_len``); ``paged_impl`` picks
     the ragged kernel (``"pallas"``) or the gather fallback (``"xla"``).
+
+    Robustness knobs: ``max_queue`` bounds admission (``None`` =
+    unbounded; overflow sheds the incoming request, or the OLDEST queued
+    one under ``shed_policy="shed-oldest"``); ``fault_retries`` is the
+    in-place retries per phase for transient faults (exhaustion escapes
+    as :class:`RetryError` for the restart-and-replay loop);
+    ``watchdog`` receives a heartbeat per ``step()`` and is paused around
+    first-time compiles.  Counters live in ``self.robust``
+    (:func:`robustness_counters` merges everything).
     """
 
     def __init__(self, config: ProGenConfig, params, *,
@@ -153,7 +225,9 @@ class ServingEngine:
                  params_shardings=None,
                  paged: bool = False, page_size: int = 16,
                  num_pages: int | None = None, paged_impl: str = "xla",
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 max_queue: int | None = None, shed_policy: str = "reject",
+                 fault_retries: int = 3, watchdog: Watchdog | None = None):
         self.config = config
         self.policy = policy or make_policy()
         self.num_slots = num_slots
@@ -165,6 +239,19 @@ class ServingEngine:
         self._inflight: dict[int, Request] = {}  # slot -> request
         self.completions: list[Completion] = []
         self.chunks_run = 0
+        if shed_policy not in ("reject", "shed-oldest"):
+            raise ValueError(f"shed_policy {shed_policy!r}: want 'reject' "
+                             f"or 'shed-oldest'")
+        self.max_queue = max_queue
+        self.shed_policy = shed_policy
+        self.fault_retries = fault_retries
+        self._watchdog = watchdog
+        self.robust = RobustnessCounters()
+        self._pending: list[Completion] = []   # sheds awaiting step() return
+        self._draining = False
+        self._aot: dict[tuple, Any] = {}       # AOT-compiled executables
+        self._compiled_keys: set[tuple] = set()
+        self._defer_streak: dict[str, int] = {}
 
         if params_shardings is not None:
             params = jax.device_put(params, {"params": params_shardings})
@@ -185,6 +272,7 @@ class ServingEngine:
         self._trace_ctx = trace_ctx
 
         self.paged = paged
+        self.paged_impl = paged_impl if paged else None
         if paged:
             self.page_size = page_size
             self.pages_per_row = -(-self.max_len // page_size)
@@ -242,6 +330,88 @@ class ServingEngine:
             "top_k": jnp.zeros((s,), jnp.int32),   # 0 = disabled
             "temp": jnp.ones((s,), jnp.float32),
         }
+
+    # ------------------------------------------------------ fault containment
+
+    def _guard(self, point: str, fn: Callable | None = None, *args,
+               key: tuple | None = None):
+        """Run ``faults.inject(point)`` + ``fn(*args)`` with transient
+        faults retried in place (no backoff — the retried work is an
+        in-process dispatch of a pure function, so re-running it is both
+        safe and deterministic).  Non-transient faults raise
+        :class:`_ContainedFault` for the caller's shed rule; transient
+        exhaustion raises :class:`RetryError`, the signal the
+        restart-and-replay loop (:func:`run_with_restarts`) catches.
+
+        ``key`` names the compiled program ``fn`` dispatches: its first
+        run pauses the watchdog (cold compiles are legitimately slow).
+        """
+        last: BaseException | None = None
+        for attempt in range(max(0, self.fault_retries) + 1):
+            try:
+                faults.inject(point)
+                if fn is None:
+                    out = None
+                elif (self._watchdog is not None and key is not None
+                        and key not in self._compiled_keys):
+                    with self._watchdog.paused():
+                        out = fn(*args)
+                else:
+                    out = fn(*args)
+                if key is not None:
+                    self._compiled_keys.add(key)
+                if attempt:
+                    self.robust.faults_contained += attempt
+                return out
+            except Exception as e:
+                if not default_classifier(e):
+                    raise _ContainedFault(point) from e
+                last = e
+        raise RetryError(
+            f"{point}: transient fault persisted through "
+            f"{max(0, self.fault_retries) + 1} attempt(s)",
+            attempts=max(0, self.fault_retries) + 1, elapsed=0.0,
+        ) from last
+
+    def _defer(self, phase: str, cause: BaseException) -> None:
+        """Record one deferred round of ``phase`` (fatal-fault
+        containment: skip the phase this step, retry next step).  A
+        streak past ``_MAX_DEFER_STREAK`` means the fault is permanent —
+        give up loudly instead of spinning."""
+        streak = self._defer_streak.get(phase, 0) + 1
+        self._defer_streak[phase] = streak
+        if streak > _MAX_DEFER_STREAK:
+            raise RuntimeError(
+                f"serve.{phase} failed {streak} consecutive rounds — "
+                f"fault is not transient and not shedding") from cause
+
+    def _admit_call(self, *args):
+        """Dispatch the admission (prefill) program: the AOT executable
+        for this prefill bucket when warmed, the jit wrapper otherwise."""
+        fn = self._aot.get(("admit", args[0].shape[1]), self._admit)
+        return fn(self._params, self.state, *args)
+
+    def _chunk_call(self, *args):
+        fn = self._aot.get(("chunk",), self._decode_chunk)
+        return fn(self._params, self.state, *args)
+
+    def _activate_xla_fallback(self) -> None:
+        """Degrade the paged decode step from the Pallas ragged kernel to
+        its bit-identical XLA gather fallback (``ops/
+        pallas_paged_attention.py``) — counted and logged, never fatal.
+        Token streams are unaffected: the two impls are numerically
+        matched, which is exactly why the fallback is safe mid-request.
+        """
+        self.robust.fallback_activations += 1
+        self.paged_impl = "xla"
+        self._paged_step_model = ProGenPagedDecodeStep(
+            config=self.config, n_rows=self.max_len, policy=self.policy,
+            impl="xla")
+        self._decode_chunk = jax.jit(self._decode_chunk_paged_impl)
+        self._aot.pop(("chunk",), None)
+        self._compiled_keys.discard(("chunk",))
+        print("serving: pallas paged kernel failed; degraded to the "
+              "bit-identical XLA fallback", flush=True)
 
     # ------------------------------------------------------------- decoding
 
@@ -461,6 +631,12 @@ class ServingEngine:
     # ----------------------------------------------------------------- API
 
     def submit(self, request: Request) -> None:
+        """Queue a request.  Structural errors (empty prime, no room to
+        generate) still raise — they are caller bugs; OPERATIONAL
+        conditions (injected faults, expired deadline, full queue) shed
+        the request as a typed completion instead, so a loaded or faulty
+        server answers every request rather than crashing on admission.
+        """
         n = len(request.tokens)
         if n < 1:
             raise ValueError(f"request {request.uid!r}: empty prime")
@@ -480,6 +656,21 @@ class ServingEngine:
                     f"request {request.uid!r}: needs up to {worst} pages "
                     f"but the pool only has {self._pool.capacity} — "
                     f"raise num_pages or lower max_new_tokens")
+        try:
+            self._guard("serve.submit")
+        except (_ContainedFault, RetryError):
+            self._shed(request, FAILED_FAULT)
+            return
+        deadline = self._deadline_of(request)
+        if deadline is not None and time.perf_counter() > deadline:
+            self._shed(request, SHED_DEADLINE)
+            return
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            if self.shed_policy == "shed-oldest":
+                self._shed(self._queue.popleft(), SHED_QUEUE_FULL)
+            else:
+                self._shed(request, SHED_QUEUE_FULL)
+                return
         self._queue.append(request)
 
     @property
@@ -490,10 +681,102 @@ class ServingEngine:
     def num_active(self) -> int:
         return len(self._inflight)
 
+    @property
+    def has_work(self) -> bool:
+        """True while anything remains for ``step()`` to do or report —
+        queued requests, in-flight slots, or shed completions not yet
+        returned by a ``step()`` call."""
+        return len(self._queue) + len(self._inflight) + \
+            len(self._pending) > 0
+
+    # ---------------------------------------------------------- shedding
+
+    @staticmethod
+    def _deadline_of(r: Request) -> float | None:
+        if r.deadline is not None:
+            return r.deadline
+        if r.ttl is not None:
+            return r.submit_time + r.ttl
+        return None
+
+    def _shed(self, r: Request, status: str, tokens=None) -> Completion:
+        """Answer ``r`` with a typed shed completion (callback fires,
+        counters bump); ``tokens`` carries any partial generation an
+        in-flight deadline cancellation salvaged."""
+        if status == SHED_QUEUE_FULL:
+            self.robust.sheds_queue_full += 1
+        elif status == SHED_DEADLINE:
+            self.robust.sheds_deadline += 1
+        else:
+            self.robust.failed_faults += 1
+        comp = Completion(
+            uid=r.uid,
+            prime=np.asarray(  # graftcheck: disable=host-sync
+                r.tokens, np.int32),
+            tokens=np.asarray(  # graftcheck: disable=host-sync
+                [] if tokens is None else tokens, np.int32),
+            finish_reason=status, status=status,
+            submit_time=r.submit_time, finish_time=time.perf_counter())
+        self.completions.append(comp)
+        self._pending.append(comp)
+        if r.on_complete is not None:
+            r.on_complete(comp)
+        return comp
+
+    def _drain_pending(self) -> list[Completion]:
+        out, self._pending = self._pending, []
+        return out
+
+    def _shed_expired(self) -> None:
+        """Shed every queued request past its deadline (before it costs a
+        prefill) and cancel expired in-flight slots (their partial tokens
+        ride along in the shed completion)."""
+        now = time.perf_counter()
+        expired_q = [r for r in self._queue
+                     if self._deadline_of(r) is not None
+                     and now > self._deadline_of(r)]
+        for r in expired_q:
+            self._queue.remove(r)
+            self._shed(r, SHED_DEADLINE)
+        slots = [s for s, r in self._inflight.items()
+                 if self._deadline_of(r) is not None
+                 and now > self._deadline_of(r)]
+        if not slots:
+            return
+        active, seq, pos, start = jax.device_get(  # graftcheck: disable=host-sync
+            (self.state["active"], self.state["seq"], self.state["pos"],
+             self.state["start"]))
+        act = self.state["active"]
+        for slot in slots:
+            r = self._inflight.pop(slot)
+            toks = (seq[slot, start[slot]: pos[slot] + 1].copy()
+                    if active[slot] else None)
+            if self.paged:
+                self._host_stop[slot] = 0
+                self._free_slot_pages(slot)
+            self._shed(r, SHED_DEADLINE, tokens=toks)
+            act = act.at[slot].set(False)
+        self.state = {**self.state, "active": act}
+
+    # ----------------------------------------------------------- admission
+
     def _admit_pending(self) -> None:
+        if not self._queue or len(self._inflight) >= self.num_slots:
+            return
+        try:
+            self._guard("serve.admit")
+        except _ContainedFault:
+            # the admission machinery is poisoned for this round: shed the
+            # queue head (livelock breaker — a permanently faulting point
+            # must not starve the whole queue) and defer the rest
+            self._shed(self._queue.popleft(), FAILED_FAULT)
+            return
         if self.paged:
             self._admit_pending_paged()
-            return
+        else:
+            self._admit_pending_dense()
+
+    def _admit_pending_dense(self) -> None:
         free = [i for i in range(self.num_slots) if i not in self._inflight]
         if not free or not self._queue:
             return
@@ -523,10 +806,24 @@ class ServingEngine:
             mask[slot] = True
             self._inflight[slot] = r
 
-        self.state = self._admit(
-            self._params, self.state, jnp.asarray(tokens),
-            jnp.asarray(lengths), jnp.asarray(stops), jnp.asarray(seeds),
-            jnp.asarray(top_k), jnp.asarray(temp), jnp.asarray(mask))
+        try:
+            self.state = self._guard(
+                "serve.prefill", self._admit_call, tokens, lengths, stops,
+                seeds, top_k, temp, mask, key=("admit", p_pad))
+        except _ContainedFault:
+            # the batch's prefill never merged: undo the bookkeeping and
+            # shed exactly the requests whose work was lost
+            for slot, r in batch:
+                self._inflight.pop(slot, None)
+                self._shed(r, FAILED_FAULT)
+        except RetryError:
+            # escape for restart-and-replay, but leave the engine
+            # consistent: the un-prefilled batch goes back to the queue
+            # front in its original order
+            for slot, r in reversed(batch):
+                self._inflight.pop(slot, None)
+                self._queue.appendleft(r)
+            raise
 
     def _admit_pending_paged(self) -> None:
         """FIFO admission gated by free slots AND free pages.
@@ -562,6 +859,7 @@ class ServingEngine:
         temp = np.ones((s,), np.float32)
         mask = np.zeros((s,), bool)
         wtable = np.full((s, self.pages_per_row), DUMP_PAGE, np.int32)
+        pending_prefix: list[tuple[tuple, int]] = []
         for slot, r in batch:
             t = np.asarray(r.tokens, np.int32)
             tokens[slot, : len(t)] = t
@@ -576,23 +874,50 @@ class ServingEngine:
             self._admit_order[slot] = self._admit_seq
             self._admit_seq += 1
             self._paused[slot] = False
-            self._plan_slot_pages(slot, r, p_pad, wtable)
+            self._plan_slot_pages(slot, r, p_pad, wtable, pending_prefix)
 
-        self.state = self._admit(
-            self._params, self.state, jnp.asarray(tokens),
-            jnp.asarray(lengths), jnp.asarray(stops), jnp.asarray(seeds),
-            jnp.asarray(top_k), jnp.asarray(temp), jnp.asarray(mask),
-            jnp.asarray(self._page_table), jnp.asarray(wtable))
+        try:
+            self.state = self._guard(
+                "serve.prefill", self._admit_call, tokens, lengths, stops,
+                seeds, top_k, temp, mask, self._page_table.copy(), wtable,
+                key=("admit", p_pad))
+        except _ContainedFault:
+            # prefill never merged: the planned pages hold nothing — free
+            # them (no prefix registration was committed, so the index
+            # cannot serve a garbage page) and shed the batch
+            for slot, r in batch:
+                self._inflight.pop(slot, None)
+                self._host_stop[slot] = 0
+                self._free_slot_pages(slot)
+                self._shed(r, FAILED_FAULT)
+            return
+        except RetryError:
+            for slot, r in reversed(batch):
+                self._inflight.pop(slot, None)
+                self._host_stop[slot] = 0
+                self._free_slot_pages(slot)
+                self._queue.appendleft(r)
+            raise
+        # prefill landed: NOW the freshly-filled full-prefix pages may be
+        # published for sharing
+        for key, pid in pending_prefix:
+            self._pool.register_prefix(key, pid)
 
     def _plan_slot_pages(self, slot: int, r: Request, p_pad: int,
-                         wtable: np.ndarray) -> None:
+                         wtable: np.ndarray,
+                         pending_prefix: list[tuple[tuple, int]]) -> None:
         """Build the slot's page list for rows ``[0, P]`` (prime + first
         sampled token): longest run of prefix-cache hits first, fresh
         private pages for the rest.  Fills the slot's ``_page_table`` row
         and its ``wtable`` row (private pages only — shared pages were
         filled by the request that first computed them and MUST stay
         read-only: rewriting them from a different prefill batch shape
-        could perturb the sharer's bits)."""
+        could perturb the sharer's bits).
+
+        Fresh full-prefix pages are NOT registered here: registrations
+        collect in ``pending_prefix`` and commit only after the guarded
+        prefill dispatch succeeds — a failed prefill must never leave the
+        index pointing at pages that were never filled."""
         ps = self.page_size
         p = len(r.tokens)
         n_pages = p // ps + 1  # decode writes row P before any page grows
@@ -611,8 +936,8 @@ class ServingEngine:
         self.prefix_hits += len(shared)
         pages = shared + fresh
         for j in range(len(shared), n_full):
-            self._pool.register_prefix(
-                prefix_key(p_pad, r.tokens, (j + 1) * ps), pages[j])
+            pending_prefix.append(
+                (prefix_key(p_pad, r.tokens, (j + 1) * ps), pages[j]))
         self._slot_pages[slot] = SlotPages(pages=pages, shared=len(shared))
         self._page_table[slot, :] = NULL_PAGE
         self._page_table[slot, : n_pages] = pages
@@ -648,6 +973,19 @@ class ServingEngine:
         live slot, the youngest is evicted until someone can run."""
         if not self._inflight:
             return
+        try:
+            self._guard("serve.page_alloc")
+        except _ContainedFault as e:
+            # contain an allocator fault like pool starvation: pause every
+            # live slot for this chunk (their rows freeze — trajectories
+            # are delayed, never altered) and retry next round
+            self._defer("page_alloc", e)
+            for slot in self._inflight:
+                if not self._paused[slot]:
+                    self.pause_events += 1
+                self._paused[slot] = True
+            return
+        self._defer_streak.pop("page_alloc", None)
         pos = jax.device_get(  # graftcheck: disable=host-sync
             self.state["pos"])
         for _ in range(len(self._inflight) + 1):
@@ -686,6 +1024,15 @@ class ServingEngine:
             self._evict_slot(victim)
 
     def _harvest_done(self) -> list[Completion]:
+        try:
+            self._guard("serve.harvest")
+        except _ContainedFault as e:
+            # finished slots stay done-but-active; the next step's harvest
+            # picks them up (their state is inert — done rows are masked
+            # no-ops in the chunk body)
+            self._defer("harvest", e)
+            return []
+        self._defer_streak.pop("harvest", None)
         # two-phase fetch: one small transfer of the per-slot flags gates
         # the call (the common case is "nothing finished"); the big seq
         # buffer only crosses the wire when some slot actually completed
@@ -718,30 +1065,76 @@ class ServingEngine:
         self.completions.extend(out)
         return out
 
-    def step(self) -> list[Completion]:
-        """One engine iteration: admit queued requests into free slots,
-        decode one chunk, harvest newly finished slots."""
-        self._admit_pending()
-        completed = self._harvest_done()  # instant EOS/length at admission
-        if self._inflight:
+    def _dispatch_chunk(self) -> None:
+        """Run one guarded decode chunk.  A fatal fault on the paged
+        Pallas kernel degrades to the bit-identical XLA fallback and
+        retries; a fatal fault anywhere else sheds the in-flight batch
+        (``_fail_inflight``) and the engine keeps serving; transient
+        exhaustion escapes as :class:`RetryError` (restart-and-replay).
+        """
+        if self.paged:
+            self._ensure_chunk_pages()
+            if not self._inflight:
+                return  # everything got evicted back to the queue
+            args = (self._page_table.copy(), self._paused.copy())
+        else:
+            args = ()
+        while True:
+            try:
+                self.state = self._guard(
+                    "serve.decode_chunk", self._chunk_call, *args,
+                    key=("chunk",))
+                self.chunks_run += 1
+                return
+            except (_ContainedFault, RetryError) as e:
+                if self.paged and self.paged_impl == "pallas":
+                    self._activate_xla_fallback()
+                    continue  # bit-identical retry on the degraded path
+                if isinstance(e, RetryError):
+                    raise
+                self._fail_inflight()
+                return
+
+    def _fail_inflight(self) -> None:
+        """Shed every in-flight request (``FAILED_FAULT``) after a fatal
+        decode fault: the batch's device state can no longer be trusted
+        to advance, but queued requests are untouched — the engine keeps
+        serving."""
+        act = self.state["active"]
+        for slot in sorted(self._inflight):
+            r = self._inflight.pop(slot)
             if self.paged:
-                self._ensure_chunk_pages()
-                self.state = self._decode_chunk(
-                    self._params, self.state,
-                    jnp.asarray(self._page_table),
-                    jnp.asarray(self._paused))
-            else:
-                self.state = self._decode_chunk(self._params, self.state)
-            self.chunks_run += 1
+                self._host_stop[slot] = 0
+                self._free_slot_pages(slot)
+            self._shed(r, FAILED_FAULT)
+            act = act.at[slot].set(False)
+        self.state = {**self.state, "active": act}
+
+    def step(self) -> list[Completion]:
+        """One engine iteration: shed expired requests, admit queued ones
+        into free slots, decode one chunk, harvest newly finished slots.
+        The return includes typed SHED completions recorded since the
+        last step (e.g. queue-full sheds from ``submit()``)."""
+        completed = self._drain_pending()
+        if self._watchdog is not None:
+            self._watchdog.beat("serve.step")
+        self._shed_expired()
+        if not self._draining:
+            self._admit_pending()
+        completed += self._drain_pending()
+        completed += self._harvest_done()  # instant EOS/length at admission
+        if self._inflight:
+            self._dispatch_chunk()
+            completed += self._drain_pending()
             completed += self._harvest_done()
         return completed
 
     def run_until_idle(self, max_chunks: int | None = None) -> list[Completion]:
-        """Drain the queue and all in-flight slots; returns completions in
-        finish order."""
+        """Drain the queue and all in-flight slots; returns completions
+        (served and shed) in finish order."""
         out: list[Completion] = []
         chunks0 = self.chunks_run
-        while self._queue or self._inflight:
+        while self.has_work:
             out.extend(self.step())
             if (max_chunks is not None
                     and self.chunks_run - chunks0 >= max_chunks):
@@ -750,3 +1143,208 @@ class ServingEngine:
                     f"({self.num_active} active, {self.pending} pending)"
                 )
         return out
+
+    # ----------------------------------------------------------- lifecycle
+
+    def drain(self, max_chunks: int | None = None) -> list[Completion]:
+        """Stop admission and finish all IN-FLIGHT requests.  The queue
+        is left intact (snapshot it, or resume stepping); returns the
+        completions finished during the drain."""
+        self._draining = True
+        try:
+            out = self._drain_pending()
+            chunks0 = self.chunks_run
+            while self._inflight or self._pending:
+                out.extend(self.step())
+                if (max_chunks is not None
+                        and self.chunks_run - chunks0 >= max_chunks):
+                    raise RuntimeError(
+                        f"drain exceeded {max_chunks} chunks with "
+                        f"{self.num_active} slot(s) still active")
+        finally:
+            self._draining = False
+        return out
+
+    def snapshot(self, path: str | None = None) -> dict:
+        """Host-side request state, enough to REPLAY every unfinished
+        request on a fresh engine: prompt, sampling params, seed, and the
+        remaining deadline budget.  Device caches are deliberately
+        absent — trajectories depend only on (params, prime, seed,
+        knobs), so replay-from-scratch is token-identical and the
+        snapshot stays tiny and restore-compatible across engine shapes
+        (slots, chunk size, paged or dense).  The generated-so-far prefix
+        is stored for observability, not for resumption.
+
+        In-flight slots are ordered before the queue so a restore serves
+        older work first.  With ``path`` the snapshot is also written as
+        JSON (atomic rename).
+        """
+        entries = []
+        if self._inflight:
+            active, seq, pos, start = jax.device_get(  # graftcheck: disable=host-sync
+                (self.state["active"], self.state["seq"],
+                 self.state["pos"], self.state["start"]))
+            for slot in sorted(self._inflight):
+                r = self._inflight[slot]
+                gen = (seq[slot, start[slot]: pos[slot] + 1].tolist()
+                       if active[slot] else [])
+                entries.append(self._snap_request(r, gen))
+        for r in self._queue:
+            entries.append(self._snap_request(r, []))
+        snap = {"version": 1, "kind": "serving_snapshot",
+                "requests": entries}
+        if path is not None:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(snap, fh)
+            os.replace(tmp, path)
+        return snap
+
+    def _snap_request(self, r: Request, generated) -> dict:
+        entry = {
+            "uid": r.uid,
+            "tokens": [int(t) for t in r.tokens],
+            "max_new_tokens": int(r.max_new_tokens),
+            "top_k": None if r.top_k is None else int(r.top_k),
+            "temperature": float(r.temperature),
+            "seed": int(r.seed),
+            "generated": [int(t) for t in generated],
+        }
+        deadline = self._deadline_of(r)
+        if deadline is not None:
+            # perf_counter instants do not survive a process restart;
+            # the REMAINING budget does
+            entry["deadline_remaining"] = max(
+                0.0, deadline - time.perf_counter())
+        return entry
+
+    def restore(self, snap, *, on_complete=None) -> int:
+        """Resubmit every request from a :meth:`snapshot` (dict or JSON
+        path) onto this (idle) engine; returns the number accepted.
+        Deadlines resume with their remaining budget.  Restored requests
+        pass through the normal ``submit()`` path, so queue bounds and
+        expired budgets shed exactly as live traffic would."""
+        if isinstance(snap, (str, os.PathLike)):
+            with open(snap) as fh:
+                snap = json.load(fh)
+        if snap.get("kind") != "serving_snapshot":
+            raise ValueError("not a serving snapshot")
+        if self._inflight or self._queue:
+            raise RuntimeError("restore() requires an idle engine")
+        now = time.perf_counter()
+        accepted = 0
+        for e in snap["requests"]:
+            r = Request(
+                uid=e["uid"], tokens=e["tokens"],
+                max_new_tokens=e["max_new_tokens"], top_k=e["top_k"],
+                temperature=e["temperature"], seed=e["seed"],
+                on_complete=on_complete, submit_time=now)
+            if "deadline_remaining" in e:
+                r.deadline = now + e["deadline_remaining"]
+            self.submit(r)
+            accepted += 1
+        return accepted
+
+    # ----------------------------------------------------- warmup + counters
+
+    def aot_warmup(self, max_prime: int | None = None) -> dict:
+        """Explicitly compile the engine's whole program grid ahead of
+        serving: one admission program per prefill bucket (``window *
+        2^k`` up to ``max_prime``, default ``max_len - 1``) plus the
+        decode-chunk program, via ``jit(...).lower().compile()``.  The
+        compiled executables are dispatched directly afterwards, so a
+        fresh (or restarted) process pays zero first-request compiles —
+        the cold-start TTFT story (``benchmarks/bench_coldstart.py``).
+        Composes with the persistent compilation cache
+        (``--compile_cache``), which turns these compiles into disk hits.
+        """
+        t0 = time.perf_counter()
+        as_shape = partial(jax.tree.map,
+                           lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype))
+        s = self.num_slots
+
+        def i32(*shape):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        # lower with the CONCRETE params/state so their shardings (mesh
+        # mode) are captured; per-call host arrays lower as abstract
+        params_sd, state_sd = as_shape(self._params), as_shape(self.state)
+        programs = 0
+        cap = min(max_prime or self.max_len - 1, self.max_len - 1)
+        for p_pad in prime_buckets(self.config.window_size,
+                                   self.config.seq_len, cap):
+            key = ("admit", p_pad)
+            if key in self._aot:
+                continue
+            admit_args = [params_sd, state_sd, i32(s, p_pad), i32(s),
+                          i32(s), jax.ShapeDtypeStruct((s,), jnp.uint32),
+                          i32(s), jax.ShapeDtypeStruct((s,), jnp.float32),
+                          jax.ShapeDtypeStruct((s,), bool)]
+            if self.paged:
+                admit_args += [i32(s, self.pages_per_row),
+                               i32(s, self.pages_per_row)]
+            self._aot[key] = self._admit.lower(*admit_args).compile()
+            self._compiled_keys.add(key)
+            programs += 1
+        if ("chunk",) not in self._aot:
+            chunk_args = [params_sd, state_sd]
+            if self.paged:
+                chunk_args += [i32(s, self.pages_per_row),
+                               jax.ShapeDtypeStruct((s,), bool)]
+            self._aot[("chunk",)] = (
+                self._decode_chunk.lower(*chunk_args).compile())
+            self._compiled_keys.add(("chunk",))
+            programs += 1
+        return {"programs": programs,
+                "seconds": time.perf_counter() - t0}
+
+    def robustness_counters(self) -> dict:
+        """Everything a chaos record needs: shed/containment tallies,
+        faults fired by the armed plan, and (paged) pool pressure."""
+        out = dict(self.robust.as_dict())
+        injector = faults.get()
+        out["faults_fired"] = injector.fired() if injector is not None else 0
+        if self.paged:
+            out["evictions"] = self.evictions
+            out["pause_events"] = self.pause_events
+            out["prefix_hits"] = self.prefix_hits
+            out["pool"] = self._pool.stats()
+        return out
+
+
+def run_with_restarts(engine_factory, requests=(), *, attempts: int = 3,
+                      snapshot_path: str | None = None,
+                      max_chunks: int | None = None,
+                      classifier=default_classifier) -> list[Completion]:
+    """Serve ``requests`` to completion across engine crashes: the
+    serving twin of the trainer's ``--run_attempts`` resume loop.
+
+    When a transient failure escapes the engine's in-place containment
+    (a :class:`RetryError`, or anything ``classifier`` calls transient),
+    the unfinished requests are snapshotted, a FRESH engine is built via
+    ``engine_factory()``, the snapshot is restored onto it, and serving
+    resumes.  Completions harvested before a crash are final (they are
+    absent from the snapshot, so nothing double-serves); replayed
+    requests are token-identical to an uninterrupted run because
+    trajectories depend only on (params, prime, seed, knobs).
+    Non-transient failures and attempt exhaustion re-raise.
+    """
+    out: list[Completion] = []
+    engine = engine_factory()
+    for r in requests:
+        engine.submit(r)
+    for attempt in range(1, max(1, attempts) + 1):
+        try:
+            out.extend(engine.run_until_idle(max_chunks=max_chunks))
+            return out
+        except Exception as e:
+            if attempt >= attempts or not classifier(e):
+                raise
+            out.extend(engine.completions[:])
+            snap = engine.snapshot(snapshot_path)
+            print(f"serving: attempt {attempt} crashed ({e!r}); "
+                  f"restarting and replaying {len(snap['requests'])} "
+                  f"request(s)", flush=True)
+            engine = engine_factory()
+            engine.restore(snap)
+    return out

@@ -197,3 +197,27 @@ class PagePool:
         self._prefix[key] = pid
         self._key_of[pid] = key
         self._ref[pid] = self._ref.get(pid, 0) + 1
+
+    def unregister_prefix(self, pid: int) -> None:
+        """Withdraw ``pid`` from the prefix index (no-op when it was never
+        published).  Needed when the prefill that was going to FILL a
+        registered page fails after planning: the index must not serve a
+        page holding garbage.  The index's reference is dropped; any
+        in-flight sharer keeps theirs."""
+        key = self._key_of.pop(pid, None)
+        if key is None:
+            return
+        del self._prefix[key]
+        self._release_ref(pid)
+
+    # ---------------------------------------------------------------- stats
+
+    def stats(self) -> dict[str, int]:
+        """Host-side accounting snapshot (robustness/chaos records)."""
+        return {
+            "num_pages": self.num_pages,
+            "capacity": self.capacity,
+            "free_pages": self.free_pages,
+            "cached_pages": self.cached_pages,
+            "pages_in_use": self.capacity - self.free_pages,
+        }

@@ -67,6 +67,26 @@ def pad_prime_length(p: int, window_size: int, seq_len: int,
     return min(windows * window_size, seq_len)
 
 
+def prime_buckets(window_size: int, seq_len: int,
+                  max_prime: int | None = None) -> list[int]:
+    """Every bucketed prefill length a serving engine can dispatch:
+    ``window_size * 2^k`` capped at ``seq_len``, for primes up to
+    ``max_prime`` (default ``seq_len``).  This is the admission program
+    grid an AOT warmup must compile — O(log(seq_len/window)) shapes.
+    """
+    cap = min(max_prime or seq_len, seq_len)
+    out: list[int] = []
+    p = 1
+    while p <= cap:
+        b = pad_prime_length(p, window_size, seq_len, bucket=True)
+        if not out or b != out[-1]:
+            out.append(b)
+        if b >= cap:
+            break
+        p = b + 1
+    return out
+
+
 def _constrain_caches(caches, mesh: Mesh, strategies: Sequence[str]):
     """Pin the decode caches' layouts over the mesh.
 

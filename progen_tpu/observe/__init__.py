@@ -7,10 +7,12 @@ from progen_tpu.observe.flops import (
 from progen_tpu.observe.gitinfo import git_sha
 from progen_tpu.observe.meter import ThroughputMeter, profile_trace
 from progen_tpu.observe.platform import emit_error_record, probe_backend
+from progen_tpu.observe.robustness import RobustnessCounters
 from progen_tpu.observe.tracker import Tracker
 
 __all__ = [
     "PEAK_BF16_TFLOPS",
+    "RobustnessCounters",
     "emit_error_record",
     "git_sha",
     "probe_backend",

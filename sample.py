@@ -45,13 +45,32 @@ honor_env_platforms()
                    "bit-identical to the fixed-slot engine")
 @click.option("--page_size", default=16, help="engine: token rows per page "
                                               "(with --paged)")
+@click.option("--serve_attempts", default=3,
+              help="engine: total tries of the serve loop — a transient "
+                   "failure snapshots the host-side request state, rebuilds "
+                   "the engine and REPLAYS the in-flight requests (per-"
+                   "request seed determinism makes the replay token-"
+                   "identical; 1 = fail fast)")
+@click.option("--snapshot_path", default=None, metavar="FILE",
+              help="engine: where crash snapshots are persisted (JSON, "
+                   "host-side request state only; default: not written "
+                   "to disk)")
+@click.option("--aot_warmup", is_flag=True,
+              help="engine: AOT-compile every (prefill bucket, decode "
+                   "chunk) program via jit(...).lower().compile() before "
+                   "accepting traffic, so no request pays a JIT pause")
+@click.option("--watchdog_timeout", default=None, type=float,
+              help="engine: seconds without a completed serve step before "
+                   "the watchdog dumps all-thread stacks to CWD and exits "
+                   "nonzero (unset = off); compiles are exempt")
 @click.option("--compile_cache", default=None, metavar="DIR",
               help="JAX persistent compilation cache directory ('0' "
                    "disables); overrides PROGEN_COMPILE_CACHE, default "
                    "~/.cache/progen_tpu/xla")
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
          seq_len, mesh_spec, strategies, serve, slots, chunk, paged,
-         page_size, compile_cache):
+         page_size, serve_attempts, snapshot_path, aot_warmup,
+         watchdog_timeout, compile_cache):
     import os
 
     import jax
@@ -112,20 +131,42 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
     print(f"trained for {max(meta['next_seq_index'], 0)} sequences")
 
     if serve:
-        from progen_tpu.decode import Request, ServingEngine
+        from progen_tpu.decode import Request, ServingEngine, run_with_restarts
+        from progen_tpu.resilience import Watchdog
 
         primes = prime.split("|") if "|" in prime else [prime] * num_samples
-        engine = ServingEngine(
-            model_config, {"params": params}, policy=policy,
-            num_slots=slots, chunk_size=chunk, max_len=seq_len,
-            paged=paged, page_size=page_size,
-            mesh=mesh, strategies=strategy_list, params_shardings=param_sh)
+        watchdog = None
+        if watchdog_timeout:
+            watchdog = Watchdog(watchdog_timeout, out_dir=".",
+                                label="serve")
+            watchdog.start()
+
+        def engine_factory():
+            eng = ServingEngine(
+                model_config, {"params": params}, policy=policy,
+                num_slots=slots, chunk_size=chunk, max_len=seq_len,
+                paged=paged, page_size=page_size,
+                mesh=mesh, strategies=strategy_list,
+                params_shardings=param_sh, watchdog=watchdog)
+            if aot_warmup:
+                stats = eng.aot_warmup()
+                print(f"aot warmup: {stats['programs']} programs in "
+                      f"{stats['seconds']:.1f}s")
+            return eng
+
+        requests = []
         for i, p in enumerate(primes):
             toks = [0] + encode_tokens(p)  # BOS-prefixed, like add_bos
-            engine.submit(Request(
+            requests.append(Request(
                 uid=i, tokens=toks, max_new_tokens=seq_len - len(toks),
                 top_k=top_k, temperature=temperature, seed=seed + i))
-        completions = engine.run_until_idle()
+        try:
+            completions = run_with_restarts(
+                engine_factory, requests, attempts=serve_attempts,
+                snapshot_path=snapshot_path)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         for comp in sorted(completions, key=lambda c: c.uid):
             print(f"\n {primes[comp.uid]} \n", "*" * 40,
                   f"[{comp.finish_reason}, {len(comp.tokens)} tokens, "

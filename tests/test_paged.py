@@ -312,6 +312,36 @@ def test_paged_engine_rejects_request_exceeding_pool(trained):
                            max_new_tokens=10))
 
 
+# --------------------------------------------------------------- sharded
+
+
+def test_paged_engine_tp2_sharded_matches_dense(trained, devices8):
+    """Paged vs fixed-slot greedy parity holds SPMD too: on a tensor-
+    parallel mesh the pooled gate pages and the page-table walk produce
+    the same tokens as the per-slot slabs, request for request."""
+    from progen_tpu.core import MeshConfig, make_mesh
+    from progen_tpu.parallel.sharding import param_shardings
+
+    model, params, policy = trained
+    mesh = make_mesh(MeshConfig(data=1, fsdp=4, tensor=2), devices=devices8)
+    strategies = ("fsdp", "tp")
+    tokens = jnp.zeros((2, CFG.seq_len), jnp.int32)
+    shardings = param_shardings(model, tokens, mesh, strategies)["params"]
+    mesh_kw = dict(mesh=mesh, strategies=strategies,
+                   params_shardings=shardings)
+
+    _, dense = _run_engine(params, policy, _mk_requests(5, max_new=6),
+                           num_slots=2, chunk_size=3, max_len=20,
+                           **mesh_kw)
+    peng, paged = _run_engine(params, policy, _mk_requests(5, max_new=6),
+                              num_slots=2, chunk_size=3, max_len=20,
+                              paged=True, page_size=4, **mesh_kw)
+    assert set(paged) == set(range(5))
+    assert paged == dense
+    assert peng._pool.free_pages + peng._pool.cached_pages == \
+        peng._pool.capacity
+
+
 # ---------------------------------------------------------------- memory
 
 

@@ -29,6 +29,15 @@ JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
     --max-new 6 --prime-min 4 --prime-max 12 \
     --paged --page-size 8
 
+echo "== chaos-serving smoke =="
+# seeded fault plan over four serving points + --verify: asserts every
+# non-shed completion is token-identical to a fault-free rerun AND that
+# snapshot -> restore -> replay reproduces the straight run exactly
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 4 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --chaos --verify --ttl 60
+
 echo "== superstep quick-bench smoke =="
 # tiny-shape K-sweep on CPU: proves the fused dispatch path runs end to
 # end and emits parseable JSON (full sweep: benchmarks/superstep.md)
