@@ -283,6 +283,14 @@ def main() -> None:
 
     ladder = os.environ.get("PROGEN_BENCH_CONFIGS")
     if ladder:
+        try:
+            # first in-process backend use: the startup probe runs in a
+            # subprocess, so the backend can still fail HERE (TPU claimed
+            # between probe and use) — emit the structured record, rc 0
+            n_chips = jax.device_count()
+        except Exception as e:
+            _emit_error_record(e)
+            return
         for name in (n.strip() for n in ladder.split(",")):
             if name not in LADDER:
                 print(f"skipping unknown ladder config {name!r} "
@@ -290,7 +298,7 @@ def main() -> None:
                       file=sys.stderr, flush=True)
                 continue
             spec = dict(LADDER[name])
-            if spec["mode"] == "fwdbwd" and jax.device_count() > 1:
+            if spec["mode"] == "fwdbwd" and n_chips > 1:
                 # fwdbwd is the single-chip stand-in for configs whose
                 # full train state exceeds one chip; on a real slice the
                 # sharded train mode is the meaningful measurement

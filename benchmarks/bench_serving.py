@@ -24,6 +24,19 @@ with N slots, for equal-budget concurrency comparisons — the record's
 ``max_in_flight`` and ``gate_hbm_bytes`` fields carry the comparison
 (see benchmarks/paged.md).
 
+``--spec`` turns on speculative decoding (``decode/spec.py``):
+``--spec-k`` drafted tokens per verify round, ``--draft tiny`` a shrunk
+random-weight draft (``draft_config_for``) instead of the default
+identity draft.  The record gains ``accepted_tokens_per_step`` (emitted
+tokens per fused verify round — above 1.0 means each decode dispatch
+produced more than one token).  ``--disagg`` splits serving into the
+prefill-worker/handoff-queue/decode-pool stages (``decode/handoff.py``);
+the record then ALSO replays the identical arrival schedule on an inline
+engine and carries ``p95_latency_s_inline`` etc. for the side-by-side.
+``--long-frac`` mixes that fraction of near-``max_len`` primes into the
+Poisson stream (the long-prefill interference scenario disaggregation
+exists for).
+
 ``--chaos`` arms the fault injector with ``--faults`` (a
 ``PROGEN_FAULTS``-syntax plan hitting the serving points) and records a
 ``serving_chaos`` line instead: goodput (tokens/sec over OK completions
@@ -90,6 +103,32 @@ def main() -> None:
                          "to the SAME modeled gate-cache HBM as a "
                          "fixed-slot engine with this many slots "
                          "(equal-budget comparison)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative decoding: draft-propose/target-"
+                         "verify rounds instead of single-token steps "
+                         "(token-identical output)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="drafted tokens per speculative round")
+    ap.add_argument("--draft", choices=("identity", "tiny"),
+                    default="identity",
+                    help="draft model: 'identity' reuses the target "
+                         "(every proposal accepted — isolates dispatch "
+                         "overhead), 'tiny' a shrunk random-init config "
+                         "(realistic acceptance dynamics)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode: prefill worker + "
+                         "bounded handoff queue + donating merge; the "
+                         "record also replays the same arrivals inline "
+                         "for the p95 comparison")
+    ap.add_argument("--prefill-batch", type=int, default=None,
+                    help="max requests per prefill-worker dispatch "
+                         "(default: num_slots)")
+    ap.add_argument("--handoff-depth", type=int, default=2,
+                    help="handoff queue bound (handles, not requests)")
+    ap.add_argument("--long-frac", type=float, default=0.0,
+                    help="fraction of requests with near-max_len primes "
+                         "(mixed long-prefill load); the rest draw short "
+                         "primes from [prime-min, prime-max/4]")
     ap.add_argument("--chaos", action="store_true",
                     help="arm the fault injector with --faults and record "
                          "a serving_chaos line (goodput, within-SLO "
@@ -158,9 +197,17 @@ def main() -> None:
     # request specs are FIXED up front so a --verify fault-free rerun
     # replays the exact same (tokens, seed) set — per-request seed
     # determinism then makes token identity a hard assert, not a hope
-    specs = [rng.integers(1, cfg.num_tokens,
-                          int(rng.integers(pmin, pmax + 1))).tolist()
-             for _ in range(args.requests)]
+    if args.long_frac > 0:
+        short_hi = max(pmin, pmax // 4)
+        specs = [rng.integers(
+            1, cfg.num_tokens,
+            pmax if rng.random() < args.long_frac
+            else int(rng.integers(pmin, short_hi + 1))).tolist()
+            for _ in range(args.requests)]
+    else:
+        specs = [rng.integers(1, cfg.num_tokens,
+                              int(rng.integers(pmin, pmax + 1))).tolist()
+                 for _ in range(args.requests)]
 
     def make_request(uid: int, submit_time: float,
                      ttl: float | None = None) -> Request:
@@ -183,8 +230,25 @@ def main() -> None:
         paged_impl=args.paged_impl, prefix_cache=not args.no_prefix_cache,
     ) if args.paged else {}
 
-    def mk_engine(*, robust: bool) -> ServingEngine:
+    spec_kwargs: dict = {}
+    if args.spec:
+        spec_kwargs = dict(spec=True, spec_k=args.spec_k)
+        if args.draft == "tiny":
+            from progen_tpu.models.configs import draft_config_for
+
+            spec_kwargs["draft_config"] = draft_config_for(cfg)
+    disagg_kwargs = dict(
+        disagg=True, prefill_batch=args.prefill_batch,
+        handoff_depth=args.handoff_depth,
+    ) if args.disagg else {}
+
+    def mk_engine(*, robust: bool, use_spec: bool | None = None,
+                  use_disagg: bool | None = None) -> ServingEngine:
         kw = dict(paged_kwargs)
+        if use_spec if use_spec is not None else args.spec:
+            kw.update(spec_kwargs)
+        if use_disagg if use_disagg is not None else args.disagg:
+            kw.update(disagg_kwargs)
         if robust:
             kw.update(max_queue=args.max_queue,
                       shed_policy=args.shed_policy)
@@ -197,49 +261,56 @@ def main() -> None:
     # warmup: compile the admission + chunk programs off the clock — AOT
     # over the whole (bucket, chunk) grid, or two sacrificial requests
     # (drawn from a SEPARATE rng so the measured specs stay fixed)
-    if args.aot_warmup:
-        stats = engine.aot_warmup(max_prime=pmax)
-        print(f"aot warmup: {stats['programs']} programs in "
-              f"{stats['seconds']:.1f}s", file=sys.stderr)
-    else:
+    def warm(eng: ServingEngine) -> None:
+        if args.aot_warmup:
+            stats = eng.aot_warmup(max_prime=pmax)
+            print(f"aot warmup: {stats['programs']} programs in "
+                  f"{stats['seconds']:.1f}s", file=sys.stderr)
+            return
         wrng = np.random.default_rng(args.seed + 999)
         for i in range(min(2, args.slots)):
-            engine.submit(Request(
+            eng.submit(Request(
                 uid=10_000_000 + i,
                 tokens=wrng.integers(1, cfg.num_tokens, pmax).tolist(),
                 max_new_tokens=args.max_new, top_k=25, temperature=1.0,
                 seed=args.seed, submit_time=time.perf_counter()))
-        engine.run_until_idle()
-        engine.completions.clear()
+        eng.run_until_idle()
+        eng.completions.clear()
 
-    if args.chaos:
-        faults.configure(args.faults, seed=args.faults_seed)
+    warm(engine)
 
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
                                          size=args.requests))
-    t0 = time.perf_counter()
-    done: list = []
-    nxt = 0
-    max_in_flight = 0
-    while len(done) < args.requests:
-        now = time.perf_counter() - t0
-        while nxt < args.requests and arrivals[nxt] <= now:
-            engine.submit(make_request(nxt, t0 + arrivals[nxt],
-                                       ttl=args.ttl))
-            nxt += 1
-        if not engine.has_work:
-            if nxt >= args.requests:
-                break  # nothing queued, nothing arriving: all accounted
-            # idle before the next arrival: sleep the gap (real servers
-            # block on the queue here)
-            time.sleep(max(0.0, arrivals[nxt] - (time.perf_counter() - t0)))
-            continue
-        done_now = engine.step()
-        done.extend(done_now)
-        # slots live DURING this chunk: survivors + those that completed
-        max_in_flight = max(max_in_flight,
-                            engine.num_active + len(done_now))
-    wall = time.perf_counter() - t0
+
+    def drive(eng: ServingEngine):
+        """Serve the fixed request set on the fixed arrival schedule."""
+        t0 = time.perf_counter()
+        served: list = []
+        nxt = 0
+        mif = 0
+        while len(served) < args.requests:
+            now = time.perf_counter() - t0
+            while nxt < args.requests and arrivals[nxt] <= now:
+                eng.submit(make_request(nxt, t0 + arrivals[nxt],
+                                        ttl=args.ttl))
+                nxt += 1
+            if not eng.has_work:
+                if nxt >= args.requests:
+                    break  # nothing queued, nothing arriving: accounted
+                # idle before the next arrival: sleep the gap (real
+                # servers block on the queue here)
+                time.sleep(max(0.0,
+                               arrivals[nxt] - (time.perf_counter() - t0)))
+                continue
+            done_now = eng.step()
+            served.extend(done_now)
+            # slots live DURING this chunk: survivors + completions
+            mif = max(mif, eng.num_active + len(done_now))
+        return served, time.perf_counter() - t0, mif
+
+    if args.chaos:
+        faults.configure(args.faults, seed=args.faults_seed)
+    done, wall, max_in_flight = drive(engine)
     counters = engine.robustness_counters()  # before the injector disarms
     if args.chaos:
         faults.configure("")
@@ -276,6 +347,42 @@ def main() -> None:
         "platform": jax.devices()[0].platform,
         "git_sha": git_sha(),
     }
+    if args.long_frac > 0:
+        record["long_frac"] = args.long_frac
+    if args.spec:
+        sc = engine.spec_counters()
+        record.update({
+            "spec": True,
+            "spec_k": args.spec_k,
+            "draft": args.draft,
+            "spec_emitted_tokens": sc["spec_emitted_tokens"],
+            "spec_verify_rounds": sc["spec_verify_rounds"],
+            # emitted tokens per fused verify dispatch: > 1.0 means each
+            # decode-step program produced more than one token
+            "accepted_tokens_per_step": round(
+                sc["accepted_tokens_per_round"], 3),
+        })
+    if args.disagg:
+        # replay the IDENTICAL specs + arrival schedule inline so the
+        # record carries the interference comparison disaggregation
+        # exists for (fault-free: the injector is already disarmed)
+        inline_eng = mk_engine(robust=True, use_disagg=False)
+        warm(inline_eng)
+        inline_done, inline_wall, _ = drive(inline_eng)
+        inline_ok = [c for c in inline_done if c.ok]
+        inline_lat = sorted(c.latency for c in inline_ok) or [0.0]
+        inline_tok = int(sum(len(c.tokens) for c in inline_ok))
+        record.update({
+            "disagg": True,
+            "prefill_batch": engine.prefill_batch,
+            "handoff_depth": args.handoff_depth,
+            "handoff": engine._handoff.stats(),
+            "tokens_per_sec_inline": round(inline_tok / inline_wall, 1),
+            "p50_latency_s_inline": round(
+                float(np.percentile(inline_lat, 50)), 3),
+            "p95_latency_s_inline": round(
+                float(np.percentile(inline_lat, 95)), 3),
+        })
     if args.paged:
         record.update({
             "page_size": args.page_size,
@@ -311,7 +418,10 @@ def main() -> None:
 
 def _verify(mk_engine, make_request, done, args) -> None:
     """Fault-free rerun + snapshot/restore replay, both asserted
-    token-identical to the measured run's non-shed completions."""
+    token-identical to the measured run's non-shed completions.  With
+    ``--spec`` (or ``--disagg``) the fault-free rerun is ALSO compared
+    against a plain inline non-speculative engine, so the whole
+    serving-mode matrix is pinned to one token stream."""
     import time
 
     clean_eng = mk_engine(robust=False)
@@ -323,6 +433,37 @@ def _verify(mk_engine, make_request, done, args) -> None:
                   if c.ok and c.tokens.tolist() != clean[c.uid]]
     assert not mismatched, (
         f"chaos run diverged from fault-free run for uids {mismatched}")
+
+    if args.spec or args.disagg:
+        plain_eng = mk_engine(robust=False, use_spec=False,
+                              use_disagg=False)
+        for uid in range(args.requests):
+            plain_eng.submit(make_request(uid, time.perf_counter()))
+        plain = {c.uid: c.tokens.tolist()
+                 for c in plain_eng.run_until_idle()}
+        assert clean == plain, (
+            "spec/disagg serving diverged from the plain engine — "
+            "bit-exactness contract broken")
+    if args.spec:
+        # explicit greedy check: temperature 0, no top-k, spec vs plain
+        from progen_tpu.decode import Request as Rq
+
+        greedy = {}
+        for use_spec, sink in ((True, {}), (False, {})):
+            eng = mk_engine(robust=False, use_spec=use_spec,
+                            use_disagg=False)
+            for uid in range(min(4, args.requests)):
+                base = make_request(uid, time.perf_counter())
+                eng.submit(Rq(
+                    uid=uid, tokens=base.tokens,
+                    max_new_tokens=base.max_new_tokens, top_k=None,
+                    temperature=0.0, seed=base.seed,
+                    submit_time=base.submit_time))
+            sink.update({c.uid: c.tokens.tolist()
+                         for c in eng.run_until_idle()})
+            greedy[use_spec] = sink
+        assert greedy[True] == greedy[False], (
+            "greedy speculative output != greedy non-speculative output")
 
     # snapshot mid-run, replay on a FRESH engine, assert token identity
     snap_eng = mk_engine(robust=False)

@@ -50,7 +50,8 @@ HOT_ZONES: tuple[Zone, ...] = (
         r"|_free_slot_pages|_evict_slot|_ensure_chunk_pages|_harvest_done"
         r"|drain|snapshot|restore|has_work|_shed_expired|_shed|_guard"
         r"|_dispatch_chunk|_fail_inflight|_activate_xla_fallback"
-        r"|_drain_pending|robustness_counters)$",
+        r"|_drain_pending|robustness_counters|_prefill_round"
+        r"|_admit_from_handoff|_prefill_worker_call|_merge_call)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
@@ -59,11 +60,17 @@ HOT_ZONES: tuple[Zone, ...] = (
                    "pause_events", "prefix_hits", "robust", "_pending",
                    "_draining", "_aot", "_compiled_keys", "_defer_streak",
                    "fault_retries", "max_queue", "shed_policy",
-                   "paged_impl", "_watchdog"}),
+                   "paged_impl", "_watchdog", "_handoff", "disagg",
+                   "spec", "spec_k", "prefill_batch", "_max_advance",
+                   "_spec_rounds"}),
     ),
     # the page pool is pure host bookkeeping between dispatches: nothing
     # in it may touch a device value, so every sync call is a finding
     Zone(r"decode/paging\.py$", r"PagePool\..*$"),
+    # the handoff queue carries device arrays inside handles but is pure
+    # host bookkeeping itself — any sync in it would sit on the step path
+    Zone(r"decode/handoff\.py$", r"HandoffQueue\..*$",
+         frozenset({"_q", "depth", "puts", "gets", "rejects"})),
     Zone(r"train/step\.py$",
          r".*\.(train_step|_train_step_body|train_multi_step|eval_step)$"),
 )

@@ -38,10 +38,22 @@ stops at the first sampled pad/EOS token (id 0) or after
 ``max_new_tokens``.  The reference's "second zero" truncation is a
 sampler-level concern; a serving request's prime is explicit.
 
+Disaggregated mode (``disagg=True``, docs/SERVING.md §6) splits the step
+into an explicit PREFILL stage (a worker program per bucket producing
+cache HANDLES into a bounded handoff queue, ``decode/handoff.py``) and a
+DECODE stage that admits from the queue via a donating merge program —
+decode chunks dispatch BEFORE the round's prefill, so a long prefill
+never stalls in-flight decode.  Speculative mode (``spec=True``,
+``decode/spec.py``) replaces the chunk's sequential target steps with
+draft-propose/target-verify rounds whose output is token-identical to
+plain decoding for any draft.
+
 Robustness (docs/RESILIENCE.md): every serving phase runs behind a named
 fault-injection point (``serve.submit`` / ``serve.admit`` /
 ``serve.prefill`` / ``serve.decode_chunk`` / ``serve.harvest`` /
-``serve.page_alloc``).  Because each phase is FUNCTIONAL — state in,
+``serve.page_alloc``, plus ``serve.handoff`` for the disaggregated merge
+and ``serve.verify`` replacing ``serve.decode_chunk`` under speculative
+decoding).  Because each phase is FUNCTIONAL — state in,
 state out, ``self.state`` replaced only on success — a transient fault is
 contained by re-running the failed dispatch in place; a fatal fault sheds
 only the requests whose work was lost, as typed completions
@@ -91,14 +103,20 @@ from progen_tpu.decode.paging import (
     pages_for_span,
     prefix_key,
 )
+from progen_tpu.decode.handoff import Handle, HandoffQueue
 from progen_tpu.decode.prefill import (
     _constrain_caches,
     harvest_caches,
     harvest_gate_pages,
     pad_prime_length,
     prime_buckets,
+    scatter_gate_rows,
 )
-from progen_tpu.decode.sampler import gumbel_topk_sample_batched
+from progen_tpu.decode.sampler import (
+    gumbel_topk_sample_batched,
+    split_keys_batched,
+)
+from progen_tpu.decode.spec import check_draft_config, spec_round
 from progen_tpu.models.progen import ProGen, ProGenConfig
 
 EOS_ID = 0
@@ -215,6 +233,25 @@ class ServingEngine:
     ``watchdog`` receives a heartbeat per ``step()`` and is paused around
     first-time compiles.  Counters live in ``self.robust``
     (:func:`robustness_counters` merges everything).
+
+    **Speculative decoding** (``spec=True``): a draft model
+    (``draft_config``/``draft_params``; defaults to the IDENTITY draft —
+    the target itself, 100% acceptance) proposes ``spec_k`` tokens per
+    round, verified in one fused target scan (``decode/spec.py``).
+    Output is token-identical to plain decoding for ANY draft — greedy
+    and sampled alike — so per-request seed determinism and
+    snapshot/replay survive unchanged.  ``draft_config`` without
+    ``draft_params`` random-initializes the draft (testing convenience;
+    a real deployment loads a trained draft).
+
+    **Disaggregated serving** (``disagg=True``): prefill runs as its own
+    worker program over FIFO-prefix batches of up to ``prefill_batch``
+    requests sharing a bucket, producing cache handles into a bounded
+    queue of ``handoff_depth`` (``decode/handoff.py``); the decode stage
+    admits by merging handles into free slots with the handle DONATED
+    (caches move, not copy).  ``step()`` dispatches the decode chunk
+    before the round's prefill, so long prefills stop stalling in-flight
+    decode.
     """
 
     def __init__(self, config: ProGenConfig, params, *,
@@ -227,7 +264,11 @@ class ServingEngine:
                  num_pages: int | None = None, paged_impl: str = "xla",
                  prefix_cache: bool = True,
                  max_queue: int | None = None, shed_policy: str = "reject",
-                 fault_retries: int = 3, watchdog: Watchdog | None = None):
+                 fault_retries: int = 3, watchdog: Watchdog | None = None,
+                 spec: bool = False, draft_config: ProGenConfig | None = None,
+                 draft_params=None, spec_k: int = 4,
+                 disagg: bool = False, prefill_batch: int | None = None,
+                 handoff_depth: int = 2):
         self.config = config
         self.policy = policy or make_policy()
         self.num_slots = num_slots
@@ -255,7 +296,40 @@ class ServingEngine:
 
         if params_shardings is not None:
             params = jax.device_put(params, {"params": params_shardings})
-        self._params = params
+
+        self.spec = spec
+        self.disagg = disagg
+        if spec:
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            self.spec_k = int(spec_k)
+            self.draft_config = draft_config or config
+            check_draft_config(config, self.draft_config)
+            if draft_params is None:
+                if draft_config is None:
+                    draft_params = params  # identity draft
+                else:
+                    from progen_tpu.parallel import unbox
+
+                    toks = jnp.zeros((1, self.draft_config.seq_len),
+                                     jnp.int32)
+                    draft_params = unbox(jax.jit(ProGen(
+                        config=self.draft_config,
+                        policy=self.policy).init)(jax.random.key(0), toks))
+            # rounds per dispatch: a fully-accepted round advances k+1
+            # positions, so the chunk budget is kept in emitted tokens
+            self._spec_rounds = max(1, chunk_size // (self.spec_k + 1))
+            self._max_advance = self._spec_rounds * (self.spec_k + 1)
+            self._draft_step_model = ProGenDecodeStep(
+                config=self.draft_config, policy=self.policy)
+            self._draft_prefill_model = ProGen(config=self.draft_config,
+                                               policy=self.policy)
+            self._spec_emitted = jnp.zeros((), jnp.int32)
+            self._spec_verify_rounds = jnp.zeros((), jnp.int32)
+            self._params = {"target": params, "draft": draft_params}
+        else:
+            self._max_advance = chunk_size
+            self._params = params
 
         if mesh is not None:
             from progen_tpu.parallel.sharding import logical_rules
@@ -293,14 +367,28 @@ class ServingEngine:
             self._paged_step_model = ProGenPagedDecodeStep(
                 config=config, n_rows=self.max_len, policy=self.policy,
                 impl=paged_impl)
-            self._decode_chunk = jax.jit(self._decode_chunk_paged_impl)
+            self._decode_chunk = jax.jit(
+                self._decode_chunk_spec_paged_impl if spec
+                else self._decode_chunk_paged_impl)
             self._admit = jax.jit(self._admit_paged_impl)
         else:
             self._step_model = ProGenDecodeStep(config=config,
                                                 policy=self.policy)
-            self._decode_chunk = jax.jit(self._decode_chunk_impl)
+            self._decode_chunk = jax.jit(
+                self._decode_chunk_spec_impl if spec
+                else self._decode_chunk_impl)
             self._admit = jax.jit(self._admit_impl)
         self._prefill_model = ProGen(config=config, policy=self.policy)
+        if disagg:
+            self.prefill_batch = max(1, min(prefill_batch or num_slots,
+                                            num_slots))
+            self._handoff = HandoffQueue(handoff_depth)
+            self._prefill_worker = jax.jit(self._prefill_worker_impl)
+            # the handle is donated: its cache buffers are dead after the
+            # merge, so XLA may move them into the slot state
+            self._merge = jax.jit(self._merge_impl, donate_argnums=(1,))
+        else:
+            self._handoff = None
         self.state = self._init_state()
 
     # ---------------------------------------------------------------- state
@@ -318,7 +406,7 @@ class ServingEngine:
             if self.mesh is not None:
                 caches = _constrain_caches(caches, self.mesh, self.strategies)
         keys = jax.vmap(jax.random.key)(jnp.zeros((s,), jnp.uint32))
-        return {
+        state = {
             "seq": jnp.zeros((s, L), jnp.int32),
             "caches": caches,
             "pos": jnp.zeros((s,), jnp.int32),     # index of newest token
@@ -330,6 +418,12 @@ class ServingEngine:
             "top_k": jnp.zeros((s,), jnp.int32),   # 0 = disabled
             "temp": jnp.ones((s,), jnp.float32),
         }
+        if self.spec:
+            # the draft's caches stay DENSE per slot even in paged mode:
+            # the draft is tiny, paging its rows would buy nothing
+            state["draft_caches"] = init_caches(
+                self.draft_config, s, self.policy, decode_len=L)
+        return state
 
     # ------------------------------------------------------ fault containment
 
@@ -395,6 +489,11 @@ class ServingEngine:
         fn = self._aot.get(("chunk",), self._decode_chunk)
         return fn(self._params, self.state, *args)
 
+    def _target_params(self, params):
+        """Under speculative decoding ``self._params`` bundles target and
+        draft weights; plain serving passes the target tree through."""
+        return params["target"] if self.spec else params
+
     def _activate_xla_fallback(self) -> None:
         """Degrade the paged decode step from the Pallas ragged kernel to
         its bit-identical XLA gather fallback (``ops/
@@ -407,7 +506,9 @@ class ServingEngine:
         self._paged_step_model = ProGenPagedDecodeStep(
             config=self.config, n_rows=self.max_len, policy=self.policy,
             impl="xla")
-        self._decode_chunk = jax.jit(self._decode_chunk_paged_impl)
+        self._decode_chunk = jax.jit(
+            self._decode_chunk_spec_paged_impl if self.spec
+            else self._decode_chunk_paged_impl)
         self._aot.pop(("chunk",), None)
         self._compiled_keys.discard(("chunk",))
         print("serving: pallas paged kernel failed; degraded to the "
@@ -430,11 +531,9 @@ class ServingEngine:
                                           axis=1)[:, 0]
                 logits, caches = self._step_model.apply(
                     params, tok, pos, st["caches"])
-                keys = jax.random.wrap_key_data(st["keys"])
-                split = jax.vmap(jax.random.split)(keys)  # (S, 2) keys
+                kd, sub = split_keys_batched(st["keys"])
                 nxt = gumbel_topk_sample_batched(
-                    split[:, 1], logits, st["top_k"], st["temp"]
-                ).astype(jnp.int32)
+                    sub, logits, st["top_k"], st["temp"]).astype(jnp.int32)
                 writepos = jnp.clip(pos + 1, 0, self.max_len - 1)
                 cur = jnp.take_along_axis(st["seq"], writepos[:, None],
                                           axis=1)[:, 0]
@@ -446,9 +545,7 @@ class ServingEngine:
                     (val == EOS_ID) | (new_pos + 1 >= st["stop"])))
                 # a slot's key advances only on its own live steps, so a
                 # request's trajectory is independent of its neighbours
-                new_keys = jnp.where(
-                    live[:, None], jax.random.key_data(split[:, 0]),
-                    st["keys"])
+                new_keys = jnp.where(live[:, None], kd, st["keys"])
                 return {**st, "seq": seq, "caches": caches, "pos": new_pos,
                         "done": done, "keys": new_keys}, None
 
@@ -464,12 +561,18 @@ class ServingEngine:
         cfg = self.config
         with self._trace_ctx():
             logits, varz = self._prefill_model.apply(
-                params, tokens, mutable=["cache"])
+                self._target_params(params), tokens, mutable=["cache"])
             caches_new = harvest_caches(cfg, varz["cache"], lengths,
                                         self.policy, self.max_len)
             if self.mesh is not None:
                 caches_new = _constrain_caches(caches_new, self.mesh,
                                                self.strategies)
+            if self.spec:
+                _, dvarz = self._draft_prefill_model.apply(
+                    params["draft"], tokens, mutable=["cache"])
+                draft_new = harvest_caches(
+                    self.draft_config, dvarz["cache"], lengths,
+                    self.policy, self.max_len)
 
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1
@@ -495,7 +598,7 @@ class ServingEngine:
             return jnp.where(m, new, old)
 
         merged_caches = jax.tree.map(merge, caches_new, state["caches"])
-        return {
+        out = {
             "seq": merge(seq, state["seq"]),
             "caches": merged_caches,
             "pos": merge(pos, state["pos"]),
@@ -507,6 +610,10 @@ class ServingEngine:
             "top_k": merge(top_k, state["top_k"]),
             "temp": merge(temp, state["temp"]),
         }
+        if self.spec:
+            out["draft_caches"] = jax.tree.map(
+                merge, draft_new, state["draft_caches"])
+        return out
 
     # -------------------------------------------------------- paged decoding
 
@@ -543,11 +650,9 @@ class ServingEngine:
                        for k in self._RING_KEYS},
                     "sgu_pool": caches["sgu_pool"],
                 }
-                keys = jax.random.wrap_key_data(st["keys"])
-                split = jax.vmap(jax.random.split)(keys)  # (S, 2) keys
+                kd, sub = split_keys_batched(st["keys"])
                 nxt = gumbel_topk_sample_batched(
-                    split[:, 1], logits, st["top_k"], st["temp"]
-                ).astype(jnp.int32)
+                    sub, logits, st["top_k"], st["temp"]).astype(jnp.int32)
                 writepos = jnp.clip(pos + 1, 0, self.max_len - 1)
                 cur = jnp.take_along_axis(st["seq"], writepos[:, None],
                                           axis=1)[:, 0]
@@ -559,9 +664,7 @@ class ServingEngine:
                     (val == EOS_ID) | (new_pos + 1 >= st["stop"])))
                 # key advances only on the slot's own live steps (see the
                 # dense body) — pausing therefore delays, never alters
-                new_keys = jnp.where(
-                    live[:, None], jax.random.key_data(split[:, 0]),
-                    st["keys"])
+                new_keys = jnp.where(live[:, None], kd, st["keys"])
                 return {**st, "seq": seq, "caches": caches, "pos": new_pos,
                         "done": done, "keys": new_keys}, None
 
@@ -578,7 +681,7 @@ class ServingEngine:
         cfg = self.config
         with self._trace_ctx():
             logits, varz = self._prefill_model.apply(
-                params, tokens, mutable=["cache"])
+                self._target_params(params), tokens, mutable=["cache"])
             caches_new = harvest_caches(cfg, varz["cache"], lengths,
                                         self.policy, self.max_len,
                                         with_sgu=False)
@@ -588,6 +691,14 @@ class ServingEngine:
             if self.mesh is not None:
                 caches_new = _constrain_caches(caches_new, self.mesh,
                                                self.strategies)
+            if self.spec:
+                # draft caches stay dense even in paged mode — the draft
+                # is small enough that paging it would buy nothing
+                _, dvarz = self._draft_prefill_model.apply(
+                    params["draft"], tokens, mutable=["cache"])
+                draft_new = harvest_caches(
+                    self.draft_config, dvarz["cache"], lengths,
+                    self.policy, self.max_len)
 
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1
@@ -615,7 +726,7 @@ class ServingEngine:
                for k in self._RING_KEYS},
             "sgu_pool": pool_new,
         }
-        return {
+        out = {
             "seq": merge(seq, state["seq"]),
             "caches": merged_caches,
             "pos": merge(pos, state["pos"]),
@@ -627,6 +738,215 @@ class ServingEngine:
             "top_k": merge(top_k, state["top_k"]),
             "temp": merge(temp, state["temp"]),
         }
+        if self.spec:
+            out["draft_caches"] = jax.tree.map(
+                merge, draft_new, state["draft_caches"])
+        return out
+
+    # --------------------------------------------------- speculative decoding
+
+    def _decode_chunk_spec_impl(self, params, state):
+        """Speculative twin of ``_decode_chunk_impl``: the chunk becomes
+        ``_spec_rounds`` propose/verify/commit rounds (``decode/spec.py``)
+        instead of ``chunk_size`` single-token target steps.  Returns
+        ``(state, stats)``; emitted-token and verify-round counts stay on
+        device (``spec_counters`` reads them off the hot path)."""
+        tgt, drf = params["target"], params["draft"]
+        with self._trace_ctx():
+            if self.mesh is not None:
+                state = {**state, "caches": _constrain_caches(
+                    state["caches"], self.mesh, self.strategies)}
+
+            def target_step(tok, pos, caches, live):
+                del live  # dense writes roll back via merge_caches
+                return self._step_model.apply(tgt, tok, pos, caches)
+
+            def draft_step(tok, pos, dc):
+                return self._draft_step_model.apply(drf, tok, pos, dc)
+
+            def merge_caches(live, new, old):
+                def mrg(n, o):
+                    m = live.reshape((-1,) + (1,) * (o.ndim - 1))
+                    return jnp.where(m, n, o)
+                return jax.tree.map(mrg, new, old)
+
+            emitted = jnp.zeros((), jnp.int32)
+            rounds = jnp.zeros((), jnp.int32)
+            for _ in range(self._spec_rounds):
+                live0 = state["active"] & ~state["done"]
+                state, em = spec_round(
+                    state, spec_k=self.spec_k, max_len=self.max_len,
+                    eos_id=EOS_ID, target_step=target_step,
+                    draft_step=draft_step, merge_caches=merge_caches,
+                    live0=live0)
+                emitted = emitted + jnp.sum(em)
+                rounds = rounds + jnp.any(live0).astype(jnp.int32)
+        return state, {"emitted": emitted, "rounds": rounds}
+
+    def _decode_chunk_spec_paged_impl(self, params, state, table, paused):
+        """Speculative + paged.  Pool writes are masked inside the step
+        via ``write_ok=live`` (a live verify step consumes a token the
+        round has already committed, so its pool write is final); only
+        ring/carry keys need the live-mask rollback, exactly as in the
+        plain paged chunk body."""
+        tgt, drf = params["target"], params["draft"]
+        with self._trace_ctx():
+            if self.mesh is not None:
+                state = {**state, "caches": _constrain_caches(
+                    state["caches"], self.mesh, self.strategies)}
+
+            def target_step(tok, pos, caches, live):
+                return self._paged_step_model.apply(
+                    tgt, tok, pos, caches, table, live)
+
+            def draft_step(tok, pos, dc):
+                return self._draft_step_model.apply(drf, tok, pos, dc)
+
+            def merge_caches(live, new, old):
+                def mrg(n, o):
+                    m = live.reshape((-1,) + (1,) * (o.ndim - 1))
+                    return jnp.where(m, n, o)
+                return {
+                    **{k: jax.tree.map(mrg, new[k], old[k])
+                       for k in self._RING_KEYS},
+                    "sgu_pool": new["sgu_pool"],
+                }
+
+            emitted = jnp.zeros((), jnp.int32)
+            rounds = jnp.zeros((), jnp.int32)
+            for _ in range(self._spec_rounds):
+                live0 = state["active"] & ~state["done"] & ~paused
+                state, em = spec_round(
+                    state, spec_k=self.spec_k, max_len=self.max_len,
+                    eos_id=EOS_ID, target_step=target_step,
+                    draft_step=draft_step, merge_caches=merge_caches,
+                    live0=live0)
+                emitted = emitted + jnp.sum(em)
+                rounds = rounds + jnp.any(live0).astype(jnp.int32)
+        return state, {"emitted": emitted, "rounds": rounds}
+
+    # ------------------------------------------------- disaggregated serving
+
+    def _prefill_worker_impl(self, params, tokens, lengths, stops, seeds,
+                             top_k, temp):
+        """Prefill stage of disaggregated serving: same math as the admit
+        impls but with NO slot state in scope — the product is a handle
+        of ``(num_slots, ...)`` slabs the merge program later gathers
+        into slots.  Gate rows stay dense here even in paged mode (the
+        worker cannot know which pool pages the rows will land in; the
+        merge scatters them through a row-indexed write table)."""
+        cfg = self.config
+        with self._trace_ctx():
+            logits, varz = self._prefill_model.apply(
+                self._target_params(params), tokens, mutable=["cache"])
+            caches = harvest_caches(cfg, varz["cache"], lengths,
+                                    self.policy, self.max_len)
+            if self.mesh is not None:
+                caches = _constrain_caches(caches, self.mesh,
+                                           self.strategies)
+            if self.spec:
+                _, dvarz = self._draft_prefill_model.apply(
+                    params["draft"], tokens, mutable=["cache"])
+                draft_caches = harvest_caches(
+                    self.draft_config, dvarz["cache"], lengths,
+                    self.policy, self.max_len)
+
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0].astype(jnp.float32)
+        keys = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
+        split = jax.vmap(jax.random.split)(keys)
+        first = gumbel_topk_sample_batched(
+            split[:, 1], last, top_k, temp).astype(jnp.int32)
+
+        s, L = self.num_slots, self.max_len
+        p_pad = tokens.shape[1]
+        tok_L = tokens[:, :L] if p_pad >= L else jnp.pad(
+            tokens, ((0, 0), (0, L - p_pad)))
+        seq = tok_L * (jnp.arange(L)[None, :] < lengths[:, None])
+        seq = seq.at[jnp.arange(s), lengths].set(first)
+        out = {
+            "seq": seq,
+            "caches": caches,
+            "pos": lengths,
+            "start": lengths,
+            "stop": stops,
+            "done": (first == EOS_ID) | (lengths + 1 >= stops),
+            "keys": jax.random.key_data(split[:, 0]),
+            "top_k": top_k,
+            "temp": temp,
+        }
+        if self.spec:
+            out["draft_caches"] = draft_caches
+        return out
+
+    def _merge_impl(self, state, hstate, gate_rows, src, mask, *extra):
+        """Decode-side half of the handoff: gather handle rows into slot
+        state.  ``src (S,)`` gives each slot its handle row (any value
+        where ``mask`` is False), ``mask (S,)`` the slots being admitted.
+        The handle is DONATED (``donate_argnums=(1,)``) — its buffers
+        alias the merged state outputs, so the caches move rather than
+        copy.  A gather (host-inverted mapping) rather than a scatter of
+        handle rows: no duplicate-index hazard, and dead rows vanish for
+        free.  In paged mode the handle's dense gate slabs ride in as
+        ``gate_rows`` (NOT donated — they scatter into the pool, so they
+        cannot alias anything) and ``extra[0]`` is ``row_wtable (S,
+        ppr)``: a handle-ROW-indexed write table (DUMP for unused rows)
+        feeding ``scatter_gate_rows``."""
+        s = self.num_slots
+        csrc = jnp.clip(src, 0, s - 1)
+
+        def take(h, old):
+            m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
+            return jnp.where(m, jnp.take(h, csrc, axis=0), old)
+
+        if self.paged:
+            (row_wtable,) = extra
+            h_caches = hstate["caches"]
+            pool = scatter_gate_rows(
+                self.config, gate_rows, hstate["start"],
+                state["caches"]["sgu_pool"], row_wtable)
+            caches = {
+                **{k: jax.tree.map(take, h_caches[k], state["caches"][k])
+                   for k in self._RING_KEYS},
+                "sgu_pool": pool,
+            }
+        else:
+            caches = jax.tree.map(take, hstate["caches"],
+                                  state["caches"])
+        out = {
+            "seq": take(hstate["seq"], state["seq"]),
+            "caches": caches,
+            "pos": take(hstate["pos"], state["pos"]),
+            "start": take(hstate["start"], state["start"]),
+            "stop": take(hstate["stop"], state["stop"]),
+            "active": state["active"] | mask,
+            "done": take(hstate["done"], state["done"]),
+            "keys": take(hstate["keys"], state["keys"]),
+            "top_k": take(hstate["top_k"], state["top_k"]),
+            "temp": take(hstate["temp"], state["temp"]),
+        }
+        if self.spec:
+            out["draft_caches"] = jax.tree.map(
+                take, hstate["draft_caches"], state["draft_caches"])
+        return out
+
+    def _prefill_worker_call(self, *args):
+        fn = self._aot.get(("prefill", args[0].shape[1]),
+                           self._prefill_worker)
+        return fn(self._params, *args)
+
+    def _merge_call(self, hstate, *args):
+        fn = self._aot.get(("merge",), self._merge)
+        if self.paged:
+            # split the gate slabs out of the donated handle (they
+            # scatter, never alias; donating them only warns)
+            gate = hstate["caches"]["sgu_gate"]
+            hstate = {**hstate, "caches": {
+                k: v for k, v in hstate["caches"].items()
+                if k != "sgu_gate"}}
+            return fn(self.state, hstate, gate, *args)
+        return fn(self.state, hstate, {}, *args)
 
     # ----------------------------------------------------------------- API
 
@@ -686,8 +1006,10 @@ class ServingEngine:
         """True while anything remains for ``step()`` to do or report —
         queued requests, in-flight slots, or shed completions not yet
         returned by a ``step()`` call."""
-        return len(self._queue) + len(self._inflight) + \
-            len(self._pending) > 0
+        n = len(self._queue) + len(self._inflight) + len(self._pending)
+        if self.disagg:
+            n += len(self._handoff)
+        return n > 0
 
     # ---------------------------------------------------------- shedding
 
@@ -903,6 +1225,152 @@ class ServingEngine:
         for key, pid in pending_prefix:
             self._pool.register_prefix(key, pid)
 
+    # ------------------------------------------- disaggregated admission
+
+    def _prefill_round(self) -> None:
+        """Prefill stage of a disaggregated step: run the worker over a
+        FIFO prefix of the queue sharing the head's bucket and push the
+        handle.  A full handoff queue skips the round entirely —
+        backpressure: prefilled caches are the expensive thing to hold,
+        so the wait is absorbed by the cheap token queue instead."""
+        if not self._queue or self._handoff.full():
+            return
+        try:
+            self._guard("serve.admit")
+        except _ContainedFault:
+            # same livelock breaker as inline admission: shed the head
+            self._shed(self._queue.popleft(), FAILED_FAULT)
+            return
+        cfg = self.config
+        p_pad = pad_prime_length(len(self._queue[0].tokens),
+                                 cfg.window_size, cfg.seq_len, bucket=True)
+        batch: list[Request] = []
+        while (self._queue and len(batch) < self.prefill_batch
+               and pad_prime_length(len(self._queue[0].tokens),
+                                    cfg.window_size, cfg.seq_len,
+                                    bucket=True) == p_pad):
+            batch.append(self._queue.popleft())
+
+        s = self.num_slots
+        tokens = np.zeros((s, p_pad), np.int32)
+        lengths = np.ones((s,), np.int32)  # dummy rows: 1-token prime
+        stops = np.full((s,), 2, np.int32)
+        seeds = np.zeros((s,), np.uint32)
+        top_k = np.zeros((s,), np.int32)
+        temp = np.ones((s,), np.float32)
+        for row, r in enumerate(batch):
+            t = np.asarray(r.tokens, np.int32)
+            tokens[row, : len(t)] = t
+            lengths[row] = len(t)
+            stops[row] = min(len(t) + r.max_new_tokens, self.max_len)
+            seeds[row] = np.uint32(int(r.seed) & 0xFFFFFFFF)
+            top_k[row] = 0 if r.top_k is None else int(r.top_k)
+            temp[row] = float(r.temperature)
+        try:
+            h = self._guard(
+                "serve.prefill", self._prefill_worker_call, tokens,
+                lengths, stops, seeds, top_k, temp,
+                key=("prefill", p_pad))
+        except _ContainedFault:
+            for r in batch:
+                self._shed(r, FAILED_FAULT)
+            return
+        except RetryError:
+            for r in reversed(batch):
+                self._queue.appendleft(r)
+            raise
+        self._handoff.put(Handle(requests=batch, state=h, p_pad=p_pad))
+
+    def _admit_from_handoff(self) -> None:
+        """Decode-side admission: move queued handles into free slots via
+        the donating merge program.  The head handle DEFERS (never
+        reorders) while slots or pages are short, exactly like inline
+        paged admission."""
+        while self._handoff:
+            h = self._handoff.peek()
+            now = time.perf_counter()
+            expired: list[Request] = []
+            live_rows: list[tuple[int, Request]] = []
+            for row, r in enumerate(h.requests):
+                d = self._deadline_of(r)
+                if d is not None and now > d:
+                    expired.append(r)
+                else:
+                    live_rows.append((row, r))
+            free = [i for i in range(self.num_slots)
+                    if i not in self._inflight]
+            if len(free) < len(live_rows):
+                return
+            if self.paged and live_rows:
+                need = sum(pages_for_span(len(r.tokens), self.page_size)
+                           for _, r in live_rows)
+                if not self._pool.can_allocate(need):
+                    return
+            self._handoff.get()
+            if live_rows:
+                src = np.zeros((self.num_slots,), np.int32)
+                mask = np.zeros((self.num_slots,), bool)
+                extra: tuple = ()
+                pending_prefix: list[tuple[tuple, int]] = []
+                placed: list[tuple[int, Request]] = []
+                if self.paged:
+                    # the merge scatters the handle's dense gate slabs
+                    # through a handle-ROW-indexed write table; the page
+                    # plan is slot-indexed, so plan into a slot scratch
+                    # row and copy it across
+                    row_wtable = np.full(
+                        (self.num_slots, self.pages_per_row), DUMP_PAGE,
+                        np.int32)
+                    scratch = np.full(
+                        (self.num_slots, self.pages_per_row), DUMP_PAGE,
+                        np.int32)
+                for slot, (row, r) in zip(free, live_rows):
+                    src[slot] = row
+                    mask[slot] = True
+                    self._inflight[slot] = r
+                    placed.append((slot, r))
+                    if self.paged:
+                        self._host_stop[slot] = min(
+                            len(r.tokens) + r.max_new_tokens, self.max_len)
+                        self._admit_order[slot] = self._admit_seq
+                        self._admit_seq += 1
+                        self._paused[slot] = False
+                        self._plan_slot_pages(slot, r, h.p_pad, scratch,
+                                              pending_prefix)
+                        row_wtable[row] = scratch[slot]
+                if self.paged:
+                    extra = (row_wtable,)
+                try:
+                    # the merge DONATES the handle's buffers; this stays
+                    # retry/requeue-safe because faults.inject raises
+                    # BEFORE the jitted program dispatches — a contained
+                    # or transient failure here has not consumed them
+                    self.state = self._guard(
+                        "serve.handoff", self._merge_call, h.state, src,
+                        mask, *extra, key=("merge",))
+                except _ContainedFault:
+                    for slot, r in placed:
+                        self._inflight.pop(slot, None)
+                        if self.paged:
+                            self._host_stop[slot] = 0
+                            self._free_slot_pages(slot)
+                        self._shed(r, FAILED_FAULT)
+                except RetryError:
+                    for slot, r in placed:
+                        self._inflight.pop(slot, None)
+                        if self.paged:
+                            self._host_stop[slot] = 0
+                            self._free_slot_pages(slot)
+                    # expired rows were NOT shed yet, so the requeued
+                    # handle replays them all exactly once after restart
+                    self._handoff.requeue(h)
+                    raise
+                else:
+                    for key, pid in pending_prefix:
+                        self._pool.register_prefix(key, pid)
+            for r in expired:
+                self._shed(r, SHED_DEADLINE)
+
     def _plan_slot_pages(self, slot: int, r: Request, p_pad: int,
                          wtable: np.ndarray,
                          pending_prefix: list[tuple[tuple, int]]) -> None:
@@ -993,8 +1461,11 @@ class ServingEngine:
             for slot in slots:
                 # last position the chunk can consume: done fires when
                 # new_pos + 1 >= stop, so a live slot never consumes past
-                # stop - 2; gate rows are written at consumed positions
-                last = min(int(pos[slot]) + self.chunk_size - 1,
+                # stop - 2; gate rows are written at consumed positions.
+                # _max_advance == chunk_size except under speculation,
+                # where a chunk of fully-accepted rounds can advance
+                # rounds * (k + 1) positions
+                last = min(int(pos[slot]) + self._max_advance - 1,
                            int(self._host_stop[slot]) - 2)
                 need = pages_for_span(last, self.page_size)
                 sp = self._slot_pages[slot]
@@ -1079,11 +1550,20 @@ class ServingEngine:
             args = (self._page_table.copy(), self._paused.copy())
         else:
             args = ()
+        point = "serve.verify" if self.spec else "serve.decode_chunk"
         while True:
             try:
-                self.state = self._guard(
-                    "serve.decode_chunk", self._chunk_call, *args,
-                    key=("chunk",))
+                out = self._guard(point, self._chunk_call, *args,
+                                  key=("chunk",))
+                if self.spec:
+                    out, stats = out
+                    # lazy device-side accumulation — spec_counters()
+                    # fetches these once, off the hot path
+                    self._spec_emitted = self._spec_emitted + \
+                        stats["emitted"]
+                    self._spec_verify_rounds = self._spec_verify_rounds + \
+                        stats["rounds"]
+                self.state = out
                 self.chunks_run += 1
                 return
             except (_ContainedFault, RetryError) as e:
@@ -1120,13 +1600,26 @@ class ServingEngine:
             self._watchdog.beat("serve.step")
         self._shed_expired()
         if not self._draining:
-            self._admit_pending()
+            if self.disagg:
+                self._admit_from_handoff()
+            else:
+                self._admit_pending()
         completed += self._drain_pending()
         completed += self._harvest_done()  # instant EOS/length at admission
         if self._inflight:
             self._dispatch_chunk()
             completed += self._drain_pending()
             completed += self._harvest_done()
+        if self.disagg and not self._draining:
+            # prefill AFTER the decode chunk: in-flight decode never
+            # stalls behind a long prefill (the disaggregation p95 win);
+            # when the decode pool is idle there is nothing to protect,
+            # so admit eagerly rather than pay a step of TTFT latency
+            self._prefill_round()
+            if not self._inflight and self._handoff:
+                self._admit_from_handoff()
+                completed += self._drain_pending()
+                completed += self._harvest_done()
         return completed
 
     def run_until_idle(self, max_chunks: int | None = None) -> list[Completion]:
@@ -1189,6 +1682,12 @@ class ServingEngine:
                 gen = (seq[slot, start[slot]: pos[slot] + 1].tolist()
                        if active[slot] else [])
                 entries.append(self._snap_request(r, gen))
+        if self.disagg:
+            # handed-off-but-unmerged requests replay from scratch like
+            # queued ones (their caches are rebuilt; token-identical)
+            for h in self._handoff:
+                for r in h.requests:
+                    entries.append(self._snap_request(r, []))
         for r in self._queue:
             entries.append(self._snap_request(r, []))
         snap = {"version": 1, "kind": "serving_snapshot",
@@ -1229,7 +1728,8 @@ class ServingEngine:
                 snap = json.load(fh)
         if snap.get("kind") != "serving_snapshot":
             raise ValueError("not a serving snapshot")
-        if self._inflight or self._queue:
+        if self._inflight or self._queue or \
+                (self.disagg and self._handoff):
             raise RuntimeError("restore() requires an idle engine")
         now = time.perf_counter()
         accepted = 0
@@ -1271,20 +1771,52 @@ class ServingEngine:
         params_sd, state_sd = as_shape(self._params), as_shape(self.state)
         programs = 0
         cap = min(max_prime or self.max_len - 1, self.max_len - 1)
-        for p_pad in prime_buckets(self.config.window_size,
-                                   self.config.seq_len, cap):
+        buckets = prime_buckets(self.config.window_size,
+                                self.config.seq_len, cap)
+        u32 = partial(jax.ShapeDtypeStruct, dtype=jnp.uint32)
+        f32 = partial(jax.ShapeDtypeStruct, dtype=jnp.float32)
+        b8 = partial(jax.ShapeDtypeStruct, dtype=jnp.bool_)
+        for p_pad in buckets:
+            if self.disagg:
+                key = ("prefill", p_pad)
+                if key in self._aot:
+                    continue
+                pre_args = [params_sd, i32(s, p_pad), i32(s), i32(s),
+                            u32((s,)), i32(s), f32((s,))]
+                self._aot[key] = (
+                    self._prefill_worker.lower(*pre_args).compile())
+                self._compiled_keys.add(key)
+                programs += 1
+                continue
             key = ("admit", p_pad)
             if key in self._aot:
                 continue
             admit_args = [params_sd, state_sd, i32(s, p_pad), i32(s),
-                          i32(s), jax.ShapeDtypeStruct((s,), jnp.uint32),
-                          i32(s), jax.ShapeDtypeStruct((s,), jnp.float32),
-                          jax.ShapeDtypeStruct((s,), bool)]
+                          i32(s), u32((s,)), i32(s), f32((s,)), b8((s,))]
             if self.paged:
                 admit_args += [i32(s, self.pages_per_row),
                                i32(s, self.pages_per_row)]
             self._aot[key] = self._admit.lower(*admit_args).compile()
             self._compiled_keys.add(key)
+            programs += 1
+        if self.disagg and ("merge",) not in self._aot:
+            # the handle's shape is bucket-independent (everything is
+            # harvested to max_len), so any bucket's worker sizes it
+            h_sd = jax.eval_shape(
+                self._prefill_worker_impl, params_sd, i32(s, buckets[0]),
+                i32(s), i32(s), u32((s,)), i32(s), f32((s,)))
+            gate_sd: dict = {}
+            if self.paged:
+                gate_sd = h_sd["caches"]["sgu_gate"]
+                h_sd = {**h_sd, "caches": {
+                    k: v for k, v in h_sd["caches"].items()
+                    if k != "sgu_gate"}}
+            merge_args = [state_sd, h_sd, gate_sd, i32(s), b8((s,))]
+            if self.paged:
+                merge_args += [i32(s, self.pages_per_row)]
+            self._aot[("merge",)] = (
+                self._merge.lower(*merge_args).compile())
+            self._compiled_keys.add(("merge",))
             programs += 1
         if ("chunk",) not in self._aot:
             chunk_args = [params_sd, state_sd]
@@ -1298,6 +1830,25 @@ class ServingEngine:
         return {"programs": programs,
                 "seconds": time.perf_counter() - t0}
 
+    def spec_counters(self) -> dict:
+        """Speculation throughput counters — ONE device fetch, so call
+        this off the hot path (the chunk impls accumulate the counts
+        lazily on device).  ``accepted_tokens_per_round`` above 1.0 means
+        each fused verify round emitted more than one token on average:
+        the dispatch-count win speculative decoding buys."""
+        if not self.spec:
+            return {}
+        emitted, rounds = jax.device_get(
+            (self._spec_emitted, self._spec_verify_rounds))
+        emitted, rounds = int(emitted), int(rounds)
+        return {
+            "spec_k": self.spec_k,
+            "spec_emitted_tokens": emitted,
+            "spec_verify_rounds": rounds,
+            "accepted_tokens_per_round":
+                (emitted / rounds) if rounds else 0.0,
+        }
+
     def robustness_counters(self) -> dict:
         """Everything a chaos record needs: shed/containment tallies,
         faults fired by the armed plan, and (paged) pool pressure."""
@@ -1309,6 +1860,8 @@ class ServingEngine:
             out["pause_events"] = self.pause_events
             out["prefix_hits"] = self.prefix_hits
             out["pool"] = self._pool.stats()
+        if self.disagg:
+            out["handoff"] = self._handoff.stats()
         return out
 
 

@@ -24,6 +24,13 @@ per-token step, designed around the model's three kinds of sequence state:
 Module/parameter names exactly mirror ``progen_tpu.models.progen.ProGen``
 (``attn{i}``/``ff{i}``/``embed``/``norm_out``/``to_logits`` with identical
 submodule names), so trained parameters bind directly to the decode graph.
+
+Speculative decoding (``decode/spec.py``) reuses this step for BOTH the
+target and the tiny draft model (a second ``ProGenDecodeStep`` over
+``draft_config_for``'s shrunk config); callers that run a step on a
+throwaway cache copy past a row's logical end must clamp positions to
+``[0, decode_len)`` themselves — the step trusts ``pos`` to index the
+SGU weight rows, it never bounds-checks it.
 """
 
 from __future__ import annotations

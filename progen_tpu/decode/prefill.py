@@ -215,6 +215,42 @@ def harvest_gate_pages(config: ProGenConfig, sown: dict, lengths, pool: dict,
     return new_pool
 
 
+def scatter_gate_rows(config: ProGenConfig, gate_rows: dict, lengths,
+                      pool: dict, wtable) -> dict:
+    """Scatter DENSE per-row gate slabs into the page pool.
+
+    The disaggregated admission path (``decode/handoff.py``): the
+    prefill worker hands off ``(B, n_rows, half)`` gate slabs per gMLP
+    layer (keyed ``str(i)`` like the dense cache), and the decode pool's
+    merge program scatters each handle row ``i < lengths[b]`` to page
+    ``wtable[b, i // page_size]`` at offset ``i % page_size`` — the same
+    contract as :func:`harvest_gate_pages`, with the slab (not the sown
+    prefill intermediates) as the source.  ``wtable`` rows for prefix-
+    shared pages, unadmitted handle rows and pad tails hold
+    ``DUMP_PAGE``.
+    """
+    from progen_tpu.decode.paging import DUMP_PAGE
+
+    new_pool = dict(pool)
+    for i in range(config.depth):
+        if not config.layer_uses_gmlp(i):
+            continue
+        gate = gate_rows[str(i)]  # (B, n_rows, half)
+        b, n_rows, half = gate.shape
+        layer_pool = pool[str(i)]  # (num_pages, page_size, half)
+        page_size = layer_pool.shape[1]
+        pages_per_row = wtable.shape[1]
+        rows = jnp.arange(n_rows)
+        page_idx = jnp.minimum(rows // page_size, pages_per_row - 1)
+        tgt = wtable[:, page_idx]  # (B, n_rows)
+        tgt = jnp.where(rows[None, :] < lengths[:, None], tgt, DUMP_PAGE)
+        off = jnp.broadcast_to((rows % page_size)[None, :], (b, n_rows))
+        new_pool[str(i)] = layer_pool.at[
+            tgt.reshape(-1), off.reshape(-1)
+        ].set(gate.astype(layer_pool.dtype).reshape(-1, half))
+    return new_pool
+
+
 def make_prefiller(config: ProGenConfig, policy: Policy | None = None,
                    mesh: Mesh | None = None,
                    strategies: Sequence[str] = ("dp",)):

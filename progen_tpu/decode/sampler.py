@@ -81,6 +81,20 @@ def gumbel_topk_sample_batched(keys, logits, top_k, temperature):
     return jnp.where(temperature == 0.0, greedy, sampled)
 
 
+def split_keys_batched(key_data):
+    """Advance a batch of raw uint32 key data one split: returns
+    ``(next_key_data, subkeys)``.  The serving engine's per-slot key
+    chains live as RAW key data (``jax.random.key_data``) so they can
+    ride through jitted state dicts; every consumer of the chain — the
+    decode chunk bodies, the speculative draft-propose and target-verify
+    scans — must derive subkeys the same way, or bit-exactness between
+    the speculative and plain paths breaks.  This helper is that one
+    way."""
+    keys = jax.random.wrap_key_data(key_data)
+    split = jax.vmap(jax.random.split)(keys)  # (B, 2) keys
+    return jax.random.key_data(split[:, 0]), split[:, 1]
+
+
 def truncate_after_eos(seq, pad_id: int = 0):
     """Zero everything after the SECOND zero (reference ``utils.py:131-134``:
     the BOS/pad at position 0 is the first; the next zero is the learned

@@ -390,6 +390,12 @@ class ServingMemoryPlan:
     pool_bytes: int           # paged mode only (0 when dense)
     table_bytes: int
     num_slots: int
+    # speculative decoding: the draft model's dense caches per slot
+    # (rings + carries + full gate slab — the draft is never paged)
+    draft_bytes_per_slot: int = 0
+    # disaggregated serving: the bounded handoff queue can hold up to
+    # ``handoff_depth`` full (num_slots, ...)-shaped handles in flight
+    handoff_bytes: int = 0
 
     @property
     def fixed_bytes_per_slot(self) -> int:
@@ -404,8 +410,10 @@ class ServingMemoryPlan:
     @property
     def total_bytes(self) -> int:
         return (self.num_slots * (self.fixed_bytes_per_slot
-                                  + self.gate_bytes_per_slot)
-                + self.pool_bytes + self.table_bytes)
+                                  + self.gate_bytes_per_slot
+                                  + self.draft_bytes_per_slot)
+                + self.pool_bytes + self.table_bytes
+                + self.handoff_bytes)
 
 
 def gate_row_bytes(cfg, mixed_precision: bool = True) -> int:
@@ -419,15 +427,24 @@ def gate_row_bytes(cfg, mixed_precision: bool = True) -> int:
 
 def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
                  mixed_precision: bool = True, paged: bool = False,
-                 page_size: int = 16,
-                 num_pages: int | None = None) -> ServingMemoryPlan:
+                 page_size: int = 16, num_pages: int | None = None,
+                 draft_cfg=None, disagg: bool = False,
+                 handoff_depth: int = 2) -> ServingMemoryPlan:
     """HBM accounting for a ServingEngine configuration (dense or paged).
 
     Mirrors ``decode/engine.py``'s state layout: k/v rings + carries +
     seq per slot always; per-slot ``(max_len, half)`` gate slabs in dense
     mode, the global ``(num_pages, page_size, half)`` pool (per gMLP
     layer) in paged mode.  ``num_pages`` defaults like the engine's
-    (full budget: every slot can reach ``max_len``)."""
+    (full budget: every slot can reach ``max_len``).
+
+    ``draft_cfg`` (speculative decoding) adds the draft model's DENSE
+    caches per slot — rings, carries and a full gate slab, since the
+    draft is never paged.  ``disagg`` adds the handoff queue's worst
+    case: ``handoff_depth`` handles, each a full ``(num_slots, ...)``
+    state copy with dense gate slabs (even in paged mode — the worker
+    hands off dense rows and the merge scatters them into the pool), plus
+    the draft caches when both modes are on."""
     act = 2 if mixed_precision else 4
     L = min(max_len or cfg.seq_len, cfg.seq_len)
     ring = 2 * cfg.window_size
@@ -446,6 +463,19 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
         pool_b = 0
         gate_b = L * row_b
         table_b = 0
+    draft_b = 0
+    if draft_cfg is not None:
+        d_ring = 2 * draft_cfg.window_size
+        draft_b = (draft_cfg.depth * 2 * draft_cfg.heads * d_ring
+                   * draft_cfg.dim_head * act
+                   + draft_cfg.depth * 2 * draft_cfg.dim * act
+                   + L * gate_row_bytes(draft_cfg, mixed_precision))
+    handoff_b = 0
+    if disagg:
+        # a handle row always carries the DENSE gate slab; ~40 B of
+        # per-row scalars (pos/start/stop/done/keys/knobs) ride along
+        per_row = ring_b + carry_b + seq_b + L * row_b + draft_b + 40
+        handoff_b = handoff_depth * num_slots * per_row
     return ServingMemoryPlan(
         ring_bytes_per_slot=ring_b,
         carry_bytes_per_slot=carry_b,
@@ -454,6 +484,8 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
         pool_bytes=pool_b,
         table_bytes=table_b,
         num_slots=num_slots,
+        draft_bytes_per_slot=draft_b,
+        handoff_bytes=handoff_b,
     )
 
 

@@ -59,6 +59,19 @@ honor_env_platforms()
               help="engine: AOT-compile every (prefill bucket, decode "
                    "chunk) program via jit(...).lower().compile() before "
                    "accepting traffic, so no request pays a JIT pause")
+@click.option("--spec", is_flag=True,
+              help="engine: speculative decoding — a draft model proposes "
+                   "--spec_k tokens per round, verified in one target step; "
+                   "greedy output is bit-identical to non-spec decode "
+                   "(docs/SERVING.md)")
+@click.option("--spec_k", default=4, help="engine: draft tokens proposed per "
+                                          "speculation round (with --spec)")
+@click.option("--disagg", is_flag=True,
+              help="engine: disaggregated serving — prefill runs in a "
+                   "separate worker program whose cache handles are merged "
+                   "into decode slots via a bounded handoff queue, so long "
+                   "prefills no longer stall in-flight decode "
+                   "(docs/SERVING.md)")
 @click.option("--watchdog_timeout", default=None, type=float,
               help="engine: seconds without a completed serve step before "
                    "the watchdog dumps all-thread stacks to CWD and exits "
@@ -70,7 +83,7 @@ honor_env_platforms()
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
          seq_len, mesh_spec, strategies, serve, slots, chunk, paged,
          page_size, serve_attempts, snapshot_path, aot_warmup,
-         watchdog_timeout, compile_cache):
+         spec, spec_k, disagg, watchdog_timeout, compile_cache):
     import os
 
     import jax
@@ -146,6 +159,7 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                 model_config, {"params": params}, policy=policy,
                 num_slots=slots, chunk_size=chunk, max_len=seq_len,
                 paged=paged, page_size=page_size,
+                spec=spec, spec_k=spec_k, disagg=disagg,
                 mesh=mesh, strategies=strategy_list,
                 params_shardings=param_sh, watchdog=watchdog)
             if aot_warmup:
