@@ -57,8 +57,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from progen_tpu.core.cache import enable_compilation_cache
-from progen_tpu.observe.gitinfo import git_sha
-from progen_tpu.observe.platform import emit_error_record, probe_backend
+from progen_tpu.observe.platform import (
+    emit_error_record,
+    probe_backend,
+    stamp_record,
+)
 
 # legacy aliases — bench_sgu/bench_superstep historically imported these
 # from here; the shared implementations live in observe/platform.py
@@ -226,7 +229,7 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
     mfu = (model_flops_per_token(cfg, num_params, sgu_impl=sgu_impl)
            * tps_chip / peak)
 
-    return {
+    return stamp_record({
         "metric": (
             f"uniref50-shaped "
             f"{'train' if mode == 'train' else 'fwd+bwd (no optimizer)'}"
@@ -250,8 +253,7 @@ def run_one(config_name: str, *, batch: int, steps: int, attn_impl: str,
         "mfu": round(mfu, 4),
         "params": num_params,
         "sgu_impl": sgu_impl,
-        "git_sha": git_sha(),
-    }
+    })
 
 
 def _run_one_guarded(config_name: str, **kwargs) -> bool:

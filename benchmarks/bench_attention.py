@@ -21,6 +21,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import statistics
 import sys
@@ -30,6 +31,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+
+from progen_tpu.observe.platform import stamp_record
 
 SHAPES = [
     (8, 8, 1024, 128, 256),   # ProGen-small class
@@ -122,6 +125,17 @@ def main() -> None:
                 f"speedup={med['xla'] / med['pallas']:.2f}x",
                 flush=True,
             )
+            b, h, l, dh, wsz = shape
+            print(json.dumps(stamp_record({
+                "bench": "attention",
+                "batch": b, "heads": h, "len": l, "dim_head": dh,
+                "window": wsz,
+                "pass": "fwd+bwd" if backward else "fwd",
+                "platform": jax.default_backend(),
+                "xla_ms": round(med["xla"], 4),
+                "pallas_ms": round(med["pallas"], 4),
+                "speedup": round(med["xla"] / med["pallas"], 3),
+            })), flush=True)
 
 
 if __name__ == "__main__":

@@ -44,8 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from progen_tpu.observe.gitinfo import git_sha
-from progen_tpu.observe.platform import probe_backend
+from progen_tpu.observe.platform import probe_backend, stamp_record
 
 
 def main() -> None:
@@ -123,7 +122,7 @@ def main() -> None:
     total_s = time.perf_counter() - t
     assert len(done) == 1 and done[0].ok
 
-    record = {
+    record = stamp_record({
         "metric": "coldstart",
         "config": args.config,
         "aot": args.aot,
@@ -139,8 +138,7 @@ def main() -> None:
         "total_s": round(total_s, 3),
         "generated_tokens": int(len(done[0].tokens)),
         "platform": jax.devices()[0].platform,
-        "git_sha": git_sha(),
-    }
+    })
     line = json.dumps(record)
     print(line, flush=True)
     if args.out:

@@ -35,6 +35,7 @@ same bench over a mesh — e.g. ProGen-large executed on the virtual
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import statistics
 import sys
@@ -43,6 +44,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from progen_tpu.core.cache import honor_env_platforms
+from progen_tpu.observe.platform import stamp_record
 
 honor_env_platforms()  # the sharded mode runs on the virtual CPU mesh
 
@@ -177,6 +179,21 @@ def main() -> None:
             f"end-to-end {(new_tokens + b * p) / med:,.0f} tokens/sec",
             flush=True,
         )
+        print(json.dumps(stamp_record({
+            "bench": "decode",
+            "config": args.config,
+            "batch": b, "length": length, "prime": args.prime,
+            "chunk": args.chunk, "mesh": args.mesh,
+            "platform": jax.default_backend(),
+            "prefill_onepass_tok_per_s": round(b * p / t_par, 1),
+            "prefill_sequential_tok_per_s": round(b * p / t_seq, 1),
+            "prefill_speedup": round(t_seq / t_par, 2),
+            "decode_tok_per_s": round(new_tokens / t_dec, 1),
+            "decode_ms_per_token": round(
+                t_dec / (length - p) * 1e3, 3),
+            "end_to_end_tok_per_s": round(
+                (new_tokens + b * p) / med, 1),
+        })), flush=True)
 
 
 if __name__ == "__main__":

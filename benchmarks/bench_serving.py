@@ -37,6 +37,18 @@ engine and carries ``p95_latency_s_inline`` etc. for the side-by-side.
 Poisson stream (the long-prefill interference scenario disaggregation
 exists for).
 
+``--serve-procs`` drives the SAME arrival schedule through a real
+multi-process cluster (``progen_tpu/serve/``): ``--prefill-procs``
+prefill worker subprocesses ship CRC-framed handle frames to
+``--replicas`` decode replica subprocesses behind the router.  The
+``serving_multiproc`` record carries per-stage ``stage_seconds`` (the
+decode process's ``prefill_s`` is 0 — prefill wall left the process),
+the cluster's transport counters, and side-by-side ``inline`` /
+``sp_disagg`` (single-process disaggregated) reruns of the identical
+schedule; ``--verify`` asserts the cluster's completions are
+token-identical to the in-process engine AND that a second fresh
+cluster replays them exactly (``benchmarks/multiproc.md``).
+
 ``--chaos`` arms the fault injector with ``--faults`` (a
 ``PROGEN_FAULTS``-syntax plan hitting the serving points) and records a
 ``serving_chaos`` line instead: goodput (tokens/sec over OK completions
@@ -69,8 +81,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from progen_tpu.observe.gitinfo import git_sha
-from progen_tpu.observe.platform import probe_backend
+from progen_tpu.observe.platform import probe_backend, stamp_record
 
 
 def main() -> None:
@@ -125,6 +136,18 @@ def main() -> None:
                          "(default: num_slots)")
     ap.add_argument("--handoff-depth", type=int, default=2,
                     help="handoff queue bound (handles, not requests)")
+    ap.add_argument("--serve-procs", action="store_true",
+                    help="multi-process serving: spawn real prefill-worker "
+                         "and decode-replica subprocesses behind the "
+                         "router (progen_tpu/serve) and drive the same "
+                         "arrival schedule through the cluster; records a "
+                         "serving_multiproc line with per-stage timing, "
+                         "transport counters, and in-process inline + "
+                         "single-process-disagg comparison reruns")
+    ap.add_argument("--prefill-procs", type=int, default=1,
+                    help="prefill worker processes (with --serve-procs)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="decode replica processes (with --serve-procs)")
     ap.add_argument("--long-frac", type=float, default=0.0,
                     help="fraction of requests with near-max_len primes "
                          "(mixed long-prefill load); the rest draw short "
@@ -237,10 +260,13 @@ def main() -> None:
             from progen_tpu.models.configs import draft_config_for
 
             spec_kwargs["draft_config"] = draft_config_for(cfg)
+    # unconditional: mk_engine applies it only when use_disagg resolves
+    # True (and --serve-procs builds sp-disagg comparison engines even
+    # without --disagg)
     disagg_kwargs = dict(
         disagg=True, prefill_batch=args.prefill_batch,
         handoff_depth=args.handoff_depth,
-    ) if args.disagg else {}
+    )
 
     def mk_engine(*, robust: bool, use_spec: bool | None = None,
                   use_disagg: bool | None = None) -> ServingEngine:
@@ -255,8 +281,6 @@ def main() -> None:
         return ServingEngine(cfg, params, policy=policy,
                              num_slots=args.slots, chunk_size=args.chunk,
                              max_len=max_len, **kw)
-
-    engine = mk_engine(robust=True)
 
     # warmup: compile the admission + chunk programs off the clock — AOT
     # over the whole (bucket, chunk) grid, or two sacrificial requests
@@ -276,8 +300,6 @@ def main() -> None:
                 seed=args.seed, submit_time=time.perf_counter()))
         eng.run_until_idle()
         eng.completions.clear()
-
-    warm(engine)
 
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
                                          size=args.requests))
@@ -308,6 +330,14 @@ def main() -> None:
             mif = max(mif, eng.num_active + len(done_now))
         return served, time.perf_counter() - t0, mif
 
+    if args.serve_procs:
+        _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
+                       drive, make_request, arrivals, pmax)
+        return
+
+    engine = mk_engine(robust=True)
+    warm(engine)
+
     if args.chaos:
         faults.configure(args.faults, seed=args.faults_seed)
     done, wall, max_in_flight = drive(engine)
@@ -323,7 +353,7 @@ def main() -> None:
     plan = serving_plan(cfg, num_slots=args.slots, max_len=max_len,
                         paged=args.paged, page_size=args.page_size,
                         num_pages=num_pages)
-    record = {
+    record = stamp_record({
         "metric": "serving_chaos" if args.chaos else "serving",
         "config": args.config,
         "requests": args.requests,
@@ -345,8 +375,7 @@ def main() -> None:
         "p95_latency_s": round(float(np.percentile(latencies, 95)), 3),
         "chunks_run": engine.chunks_run,
         "platform": jax.devices()[0].platform,
-        "git_sha": git_sha(),
-    }
+    })
     if args.long_frac > 0:
         record["long_frac"] = args.long_frac
     if args.spec:
@@ -408,6 +437,156 @@ def main() -> None:
     if args.verify:
         _verify(mk_engine, make_request, done, args)
         record["verified"] = True
+
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(line + "\n")
+
+
+def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
+                   drive, make_request, arrivals, pmax) -> None:
+    """--serve-procs: measure the real multi-process cluster on the same
+    arrival schedule, then rerun it in-process (inline AND single-process
+    disagg) so one record carries the whole comparison.  The per-stage
+    timing fields prove the prefill wall left the decode process
+    (``decode:*`` replicas report ``prefill_s == 0``)."""
+    if args.chaos:
+        raise SystemExit("--chaos drives the in-process fault injector; "
+                         "multi-process fault coverage lives in "
+                         "tests/test_serve_multiproc.py")
+    from progen_tpu.decode import Request
+    from progen_tpu.serve.cluster import ServeCluster
+    from progen_tpu.serve.worker import make_spec
+
+    engine_kw = dict(num_slots=args.slots, chunk_size=args.chunk,
+                     max_len=max_len,
+                     prefill_batch=args.prefill_batch,
+                     handoff_depth=args.handoff_depth, **paged_kwargs)
+    draft_config = None
+    if args.spec:
+        engine_kw.update(spec=True, spec_k=args.spec_k)
+        if args.draft == "tiny":
+            from progen_tpu.models.configs import draft_config_for
+
+            draft_config = draft_config_for(cfg)
+    # init_seed=0 + mixed_precision=True is EXACTLY this script's param
+    # recipe, so the workers' params are bit-identical to the in-process
+    # comparison engines' — token identity is assertable
+    wspec = make_spec(cfg, mixed_precision=True, init_seed=0,
+                      engine=engine_kw, draft_config=draft_config)
+
+    def drive_cluster():
+        cluster = ServeCluster(wspec, prefill_procs=args.prefill_procs,
+                               replicas=args.replicas)
+        try:
+            # warm the fleet off the clock: sacrificial requests compile
+            # prefill + merge + chunk programs in the workers
+            wrng = np.random.default_rng(args.seed + 999)
+            for i in range(max(2, args.prefill_procs, args.replicas)):
+                cluster.submit(Request(
+                    uid=10_000_000 + i,
+                    tokens=wrng.integers(1, cfg.num_tokens, pmax).tolist(),
+                    max_new_tokens=args.max_new, top_k=25, temperature=1.0,
+                    seed=args.seed, submit_time=time.perf_counter()))
+            cluster.drain(timeout=600.0)
+            cluster.poll(0.0)  # discard the warm completions
+
+            t0 = time.perf_counter()
+            served: list = []
+            nxt = 0
+            while len(served) < args.requests:
+                now = time.perf_counter() - t0
+                while nxt < args.requests and arrivals[nxt] <= now:
+                    cluster.submit(make_request(nxt, t0 + arrivals[nxt],
+                                                ttl=args.ttl))
+                    nxt += 1
+                served.extend(cluster.poll(0.02))
+            wall = time.perf_counter() - t0
+        finally:
+            stats = cluster.shutdown()
+        return served, wall, stats
+
+    done, wall, stats = drive_cluster()
+    ok = [c for c in done if c.ok]
+    lat = sorted(c.latency for c in ok) or [0.0]
+    gen = int(sum(len(c.tokens) for c in ok))
+
+    def rerun(use_disagg: bool):
+        eng = mk_engine(robust=True, use_disagg=use_disagg)
+        warm(eng)
+        r_done, r_wall, _ = drive(eng)
+        r_ok = [c for c in r_done if c.ok]
+        r_lat = sorted(c.latency for c in r_ok) or [0.0]
+        r_tok = int(sum(len(c.tokens) for c in r_ok))
+        return {
+            "tokens_per_sec": round(r_tok / r_wall, 1),
+            "p50_latency_s": round(float(np.percentile(r_lat, 50)), 3),
+            "p95_latency_s": round(float(np.percentile(r_lat, 95)), 3),
+        }
+
+    sp_disagg = rerun(use_disagg=True)   # single-process disagg
+    inline = rerun(use_disagg=False)
+
+    record = stamp_record({
+        "metric": "serving_multiproc",
+        "config": args.config,
+        "requests": args.requests,
+        "rate_per_sec": args.rate,
+        "slots": args.slots,
+        "chunk": args.chunk,
+        "max_new_tokens": args.max_new,
+        "max_len": max_len,
+        "paged": args.paged,
+        "spec": args.spec,
+        "prefill_procs": args.prefill_procs,
+        "replicas": args.replicas,
+        "prefill_batch": engine_kw["prefill_batch"],
+        "handoff_depth": args.handoff_depth,
+        "wall_s": round(wall, 3),
+        "generated_tokens": gen,
+        "ok_requests": len(ok),
+        "tokens_per_sec": round(gen / wall, 1),
+        "p50_latency_s": round(float(np.percentile(lat, 50)), 3),
+        "p95_latency_s": round(float(np.percentile(lat, 95)), 3),
+        # per-stage wall time per worker: decode replicas must report
+        # prefill_s == 0.0 — the prefill wall left the process entirely
+        "stage_seconds": {w: st.get("stage_seconds")
+                          for w, st in stats["workers"].items()},
+        # frames / bytes / serialize+deserialize seconds, summed over
+        # the router and every worker
+        "transport": stats["transport_total"],
+        # per-replica load counters (prefill_load / outstanding_tokens
+        # per instance, maxima over the run)
+        "router": stats["router"],
+        "supervision": stats["supervision"],
+        "sp_disagg": sp_disagg,
+        "inline": inline,
+        "platform": jax.devices()[0].platform,
+    })
+
+    if args.verify:
+        # token identity: every cluster completion must match the plain
+        # single-process engine on the same (tokens, seed) set
+        plain = mk_engine(robust=False, use_spec=False, use_disagg=False)
+        for uid in range(args.requests):
+            plain.submit(make_request(uid, time.perf_counter()))
+        clean = {c.uid: c.tokens.tolist() for c in plain.run_until_idle()}
+        mismatched = [c.uid for c in ok
+                      if [int(t) for t in c.tokens] != clean[c.uid]]
+        assert not mismatched, (
+            f"multi-process serving diverged from the single-process "
+            f"engine for uids {mismatched}")
+        # replay parity: a SECOND fresh cluster (new processes, new
+        # placement) must serve bit-identical tokens
+        done2, _, _ = drive_cluster()
+        first = {c.uid: [int(t) for t in c.tokens] for c in done if c.ok}
+        second = {c.uid: [int(t) for t in c.tokens] for c in done2 if c.ok}
+        assert first == second, "cluster replay diverged between runs"
+        record["verified"] = True
+        print("verify: multiproc token-identity and cluster replay "
+              "parity OK", file=sys.stderr)
 
     line = json.dumps(record)
     print(line, flush=True)

@@ -37,7 +37,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-from progen_tpu.observe.gitinfo import git_sha
+from progen_tpu.observe.platform import stamp_record
 
 # d = dim * ff_mult / 2 of the ProGen-small class (the gmlp hidden half)
 SWEEP_N = (512, 1024, 2048)
@@ -128,7 +128,7 @@ def main() -> None:
                     times[impl].append(time_one(run, n, args.d, args.batch))
             med = {impl: statistics.median(ts) / args.iters * 1e3
                    for impl, ts in times.items()}
-            print(json.dumps({
+            print(json.dumps(stamp_record({
                 "bench": "sgu",
                 "n": n,
                 "d": args.d,
@@ -142,8 +142,7 @@ def main() -> None:
                 "blocks_executed": skip["blocks_executed"],
                 "blocks_dense": skip["blocks_dense"],
                 "flop_ratio": round(skip["ratio"], 5),
-                "git_sha": git_sha(),
-            }), flush=True)
+            })), flush=True)
 
 
 if __name__ == "__main__":

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from progen_tpu.observe.gitinfo import git_sha
+from progen_tpu.observe.platform import stamp_record
 
 DEFAULT_KS = (1, 4, 8, 16)
 
@@ -155,7 +155,7 @@ def main() -> None:
     base = results.get(1)
     for k in ks:
         sps = results[k]
-        print(json.dumps({
+        print(json.dumps(stamp_record({
             "bench": "superstep",
             "k": k,
             "accum": args.accum,
@@ -169,8 +169,7 @@ def main() -> None:
                 sps * args.batch * args.accum * cfg.seq_len, 1),
             "speedup_vs_k1": round(sps / base, 3) if base else None,
             "platform": platform,
-            "git_sha": git_sha(),
-        }), flush=True)
+        })), flush=True)
 
 
 if __name__ == "__main__":

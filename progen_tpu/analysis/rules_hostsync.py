@@ -51,7 +51,8 @@ HOT_ZONES: tuple[Zone, ...] = (
         r"|drain|snapshot|restore|has_work|_shed_expired|_shed|_guard"
         r"|_dispatch_chunk|_fail_inflight|_activate_xla_fallback"
         r"|_drain_pending|robustness_counters|_prefill_round"
-        r"|_admit_from_handoff|_prefill_worker_call|_merge_call)$",
+        r"|_admit_from_handoff|_prefill_worker_call|_merge_call"
+        r"|admit_handle|run_prefill_round|drain_sheds)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
@@ -62,15 +63,38 @@ HOT_ZONES: tuple[Zone, ...] = (
                    "fault_retries", "max_queue", "shed_policy",
                    "paged_impl", "_watchdog", "_handoff", "disagg",
                    "spec", "spec_k", "prefill_batch", "_max_advance",
-                   "_spec_rounds"}),
+                   "_spec_rounds", "remote_prefill", "stage_seconds"}),
     ),
     # the page pool is pure host bookkeeping between dispatches: nothing
     # in it may touch a device value, so every sync call is a finding
     Zone(r"decode/paging\.py$", r"PagePool\..*$"),
     # the handoff queue carries device arrays inside handles but is pure
     # host bookkeeping itself — any sync in it would sit on the step path
+    # (module-level serialize_handle/deserialize_handle are TRANSPORT and
+    # deliberately unzoned: they run on worker/transport threads where the
+    # one batched device_get/device_put per frame is the whole point)
     Zone(r"decode/handoff\.py$", r"HandoffQueue\..*$",
          frozenset({"_q", "depth", "puts", "gets", "rejects"})),
+    # the serving router is placement policy on the admission path: pure
+    # host bookkeeping, any sync would serialize the whole cluster
+    Zone(r"serve/router\.py$", r"Router\..*$",
+         frozenset({"prefill_alive", "replica_alive", "prefill_load",
+                    "outstanding", "requests", "stage", "batches",
+                    "completed", "submit_times", "max_prefill_queue",
+                    "max_outstanding"})),
+    # the cluster's ADMISSION/event side must not sync (wire headers are
+    # parsed JSON; numpy-building lives in module helpers outside the
+    # zone); spawn/accept/log plumbing is transport-side and unzoned
+    Zone(r"serve/cluster\.py$",
+         r"ServeCluster\.(submit|_dispatch|_shed|poll|pending|drain"
+         r"|_pump|_handle_event|_on_hello|_on_handle|_on_peer_dead"
+         r"|_check_stale)$",
+         frozenset({"router", "completions", "supervisor", "counters",
+                    "_new", "_events", "_peers", "_procs",
+                    "_handled_dead", "_respawning", "_parked_uids",
+                    "_worker_stats", "_hb", "_shutting_down",
+                    "stale_after", "prefill_procs", "replicas",
+                    "spec"})),
     Zone(r"train/step\.py$",
          r".*\.(train_step|_train_step_body|train_multi_step|eval_step)$"),
 )

@@ -268,7 +268,7 @@ class ServingEngine:
                  spec: bool = False, draft_config: ProGenConfig | None = None,
                  draft_params=None, spec_k: int = 4,
                  disagg: bool = False, prefill_batch: int | None = None,
-                 handoff_depth: int = 2):
+                 handoff_depth: int = 2, remote_prefill: bool = False):
         self.config = config
         self.policy = policy or make_policy()
         self.num_slots = num_slots
@@ -293,6 +293,11 @@ class ServingEngine:
         self._aot: dict[tuple, Any] = {}       # AOT-compiled executables
         self._compiled_keys: set[tuple] = set()
         self._defer_streak: dict[str, int] = {}
+        # dispatch wall per stage (perf_counter deltas around the guarded
+        # device calls) — multi-process bench records prove prefill wall
+        # LEAVES the decode process (its prefill_s stays 0.0)
+        self.stage_seconds = {"prefill_s": 0.0, "merge_s": 0.0,
+                              "decode_chunk_s": 0.0}
 
         if params_shardings is not None:
             params = jax.device_put(params, {"params": params_shardings})
@@ -379,6 +384,9 @@ class ServingEngine:
                 else self._decode_chunk_impl)
             self._admit = jax.jit(self._admit_impl)
         self._prefill_model = ProGen(config=config, policy=self.policy)
+        if remote_prefill and not disagg:
+            raise ValueError("remote_prefill requires disagg=True")
+        self.remote_prefill = remote_prefill
         if disagg:
             self.prefill_batch = max(1, min(prefill_batch or num_slots,
                                             num_slots))
@@ -1128,10 +1136,12 @@ class ServingEngine:
             mask[slot] = True
             self._inflight[slot] = r
 
+        t0 = time.perf_counter()
         try:
             self.state = self._guard(
                 "serve.prefill", self._admit_call, tokens, lengths, stops,
                 seeds, top_k, temp, mask, key=("admit", p_pad))
+            self.stage_seconds["prefill_s"] += time.perf_counter() - t0
         except _ContainedFault:
             # the batch's prefill never merged: undo the bookkeeping and
             # shed exactly the requests whose work was lost
@@ -1198,11 +1208,13 @@ class ServingEngine:
             self._paused[slot] = False
             self._plan_slot_pages(slot, r, p_pad, wtable, pending_prefix)
 
+        t0 = time.perf_counter()
         try:
             self.state = self._guard(
                 "serve.prefill", self._admit_call, tokens, lengths, stops,
                 seeds, top_k, temp, mask, self._page_table.copy(), wtable,
                 key=("admit", p_pad))
+            self.stage_seconds["prefill_s"] += time.perf_counter() - t0
         except _ContainedFault:
             # prefill never merged: the planned pages hold nothing — free
             # them (no prefix registration was committed, so the index
@@ -1266,11 +1278,13 @@ class ServingEngine:
             seeds[row] = np.uint32(int(r.seed) & 0xFFFFFFFF)
             top_k[row] = 0 if r.top_k is None else int(r.top_k)
             temp[row] = float(r.temperature)
+        t0 = time.perf_counter()
         try:
             h = self._guard(
                 "serve.prefill", self._prefill_worker_call, tokens,
                 lengths, stops, seeds, top_k, temp,
                 key=("prefill", p_pad))
+            self.stage_seconds["prefill_s"] += time.perf_counter() - t0
         except _ContainedFault:
             for r in batch:
                 self._shed(r, FAILED_FAULT)
@@ -1340,6 +1354,7 @@ class ServingEngine:
                         row_wtable[row] = scratch[slot]
                 if self.paged:
                     extra = (row_wtable,)
+                t0 = time.perf_counter()
                 try:
                     # the merge DONATES the handle's buffers; this stays
                     # retry/requeue-safe because faults.inject raises
@@ -1348,6 +1363,8 @@ class ServingEngine:
                     self.state = self._guard(
                         "serve.handoff", self._merge_call, h.state, src,
                         mask, *extra, key=("merge",))
+                    self.stage_seconds["merge_s"] += \
+                        time.perf_counter() - t0
                 except _ContainedFault:
                     for slot, r in placed:
                         self._inflight.pop(slot, None)
@@ -1552,9 +1569,12 @@ class ServingEngine:
             args = ()
         point = "serve.verify" if self.spec else "serve.decode_chunk"
         while True:
+            t0 = time.perf_counter()
             try:
                 out = self._guard(point, self._chunk_call, *args,
                                   key=("chunk",))
+                self.stage_seconds["decode_chunk_s"] += \
+                    time.perf_counter() - t0
                 if self.spec:
                     out, stats = out
                     # lazy device-side accumulation — spec_counters()
@@ -1614,13 +1634,51 @@ class ServingEngine:
             # prefill AFTER the decode chunk: in-flight decode never
             # stalls behind a long prefill (the disaggregation p95 win);
             # when the decode pool is idle there is nothing to protect,
-            # so admit eagerly rather than pay a step of TTFT latency
-            self._prefill_round()
+            # so admit eagerly rather than pay a step of TTFT latency.
+            # A remote-prefill replica never runs the prefill stage at
+            # all — handles arrive via admit_handle() from the transport
+            if not self.remote_prefill:
+                self._prefill_round()
             if not self._inflight and self._handoff:
                 self._admit_from_handoff()
                 completed += self._drain_pending()
                 completed += self._harvest_done()
         return completed
+
+    # ----------------------------------------- multi-process handoff API
+
+    def admit_handle(self, handle: Handle) -> bool:
+        """Remote-handoff admission source (docs/SERVING.md §7): push a
+        deserialized prefill product into the bounded handoff queue
+        beside the in-process path.  False when the queue is at depth —
+        the transport keeps the frame buffered and retries after a
+        ``step()`` frees a slot (cross-process backpressure)."""
+        if not self.disagg:
+            raise RuntimeError("admit_handle() requires disagg=True")
+        if self._handoff.full():
+            return False
+        return self._handoff.put(handle)
+
+    def run_prefill_round(self) -> Handle | None:
+        """Run one prefill round and POP the produced handle instead of
+        leaving it queued — the prefill-worker process serializes it onto
+        the wire, so the local queue must not absorb the backpressure
+        that belongs to the remote replicas (the worker's credit window
+        does that).  None when the queue was empty or the round shed."""
+        if not self.disagg:
+            raise RuntimeError("run_prefill_round() requires disagg=True")
+        before = len(self._handoff)
+        self._prefill_round()
+        if len(self._handoff) > before:
+            return self._handoff.get()
+        return None
+
+    def drain_sheds(self) -> list[Completion]:
+        """Collect typed shed completions recorded since the last call
+        (submit-time sheds, failed prefill rounds).  The prefill-worker
+        process never calls ``step()``, so this is its path for shipping
+        sheds home as completion messages."""
+        return self._drain_pending()
 
     def run_until_idle(self, max_chunks: int | None = None) -> list[Completion]:
         """Drain the queue and all in-flight slots; returns completions

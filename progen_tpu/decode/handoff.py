@@ -16,16 +16,44 @@ handle back at the FRONT after a transiently failed merge without
 counting against the bound (the handle was already admitted once; a
 crash-replay loop must not deadlock against its own backpressure).
 
-This module is pure host-side bookkeeping between dispatches — handles
-carry device arrays, but nothing here may force a sync (enforced by a
-graftcheck host-sync zone, like ``decode/paging.py``).
+The :class:`HandoffQueue` is pure host-side bookkeeping between
+dispatches — handles carry device arrays, but nothing in the queue may
+force a sync (enforced by a graftcheck host-sync zone, like
+``decode/paging.py``).  The module-level ``serialize_handle`` /
+``deserialize_handle`` functions below are the opposite: they ARE the
+cross-process transport (docs/SERVING.md §7) and sync by design
+(``device_get`` on send, ``device_put`` on receive) — they run on
+transport threads, never on the admission path, and are deliberately
+OUTSIDE the host-sync zone.
+
+Wire format (one handle = one frame)::
+
+    <4sHHIQII> prefix (28 bytes, little-endian):
+        magic  b"PGHF" | version u16 | reserved u16
+        header_len u32 | payload_len u64
+        header_crc u32 | payload_crc u32 (zlib.crc32)
+    header: UTF-8 JSON — request rows, p_pad, and a manifest of
+        (path, dtype, shape, offset, nbytes) per state leaf
+    payload: the raw array bytes, concatenated at manifest offsets
+
+A payload CRC mismatch raises :class:`FrameCorrupt` — the prefix and
+header survived, so the stream is still framed and the router can shed
+or replay exactly the requests named in the header.  A bad magic /
+version / truncated read raises :class:`FrameDesync` — the stream can
+no longer be trusted and the connection is poisoned (the supervisor
+restarts the stage).  Both are typed: a corrupt frame sheds, never
+crashes.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import struct
+import time
+import zlib
 from collections import deque
-from typing import Any
+from typing import Any, Sequence
 
 
 @dataclasses.dataclass
@@ -101,3 +129,274 @@ class HandoffQueue:
         return {"depth": self.depth, "queued": len(self._q),
                 "puts": self.puts, "gets": self.gets,
                 "rejects": self.rejects}
+
+
+# --------------------------------------------------------------- wire format
+#
+# Transport layer: everything below may sync (device_get / device_put);
+# it runs on transport threads only — see module docstring.
+
+FRAME_MAGIC = b"PGHF"
+FRAME_VERSION = 1
+_PREFIX = struct.Struct("<4sHHIQII")
+FRAME_PREFIX_LEN = _PREFIX.size  # 28
+
+
+class FrameError(Exception):
+    """A frame failed to decode.  Never escapes the serving runtime as a
+    crash: subclasses pick the recovery (shed vs restart)."""
+
+
+class FrameCorrupt(FrameError):
+    """Payload CRC mismatch with an intact prefix+header: the stream is
+    still framed — shed/replay the requests named in the header and keep
+    the connection."""
+
+    def __init__(self, msg: str, header: dict | None = None):
+        super().__init__(msg)
+        self.header = header
+
+
+class FrameDesync(FrameError):
+    """Bad magic/version, header corruption, or a truncated read: the
+    byte stream can no longer be trusted — poison the connection and let
+    stage supervision restart the peer."""
+
+
+def pack_frame(header: dict, payload_parts: Sequence = ()) -> bytes:
+    """Assemble one wire frame from a JSON-able header and raw payload
+    parts (bytes-likes, concatenated in order)."""
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    parts = [memoryview(p).cast("B") for p in payload_parts]
+    payload_len = sum(p.nbytes for p in parts)
+    payload_crc = 0
+    for p in parts:
+        payload_crc = zlib.crc32(p, payload_crc)
+    out = bytearray(_PREFIX.size + len(hdr) + payload_len)
+    _PREFIX.pack_into(out, 0, FRAME_MAGIC, FRAME_VERSION, 0, len(hdr),
+                      payload_len, zlib.crc32(hdr), payload_crc)
+    out[_PREFIX.size:_PREFIX.size + len(hdr)] = hdr
+    off = _PREFIX.size + len(hdr)
+    for p in parts:
+        out[off:off + p.nbytes] = p
+        off += p.nbytes
+    return bytes(out)
+
+
+def parse_prefix(prefix: bytes) -> tuple[int, int, int, int]:
+    """Validate a 28-byte frame prefix; returns ``(header_len,
+    payload_len, header_crc, payload_crc)``.  :class:`FrameDesync` on a
+    short read, bad magic, or unknown version."""
+    if len(prefix) < _PREFIX.size:
+        raise FrameDesync(
+            f"truncated frame prefix: {len(prefix)} < {_PREFIX.size} bytes")
+    magic, version, _, hlen, plen, hcrc, pcrc = _PREFIX.unpack_from(prefix)
+    if magic != FRAME_MAGIC:
+        raise FrameDesync(f"bad frame magic {magic!r}")
+    if version != FRAME_VERSION:
+        raise FrameDesync(f"unsupported frame version {version}")
+    return hlen, plen, hcrc, pcrc
+
+
+def unpack_frame(buf) -> tuple[dict, memoryview]:
+    """Split one complete frame back into ``(header, payload_view)``.
+
+    ``payload_view`` is a zero-copy view into ``buf``.  Raises
+    :class:`FrameDesync` (untrustworthy stream) or :class:`FrameCorrupt`
+    (payload CRC with a good header — ``.header`` names the casualties).
+    """
+    view = memoryview(buf).cast("B")
+    hlen, plen, hcrc, pcrc = parse_prefix(bytes(view[:_PREFIX.size]))
+    end = _PREFIX.size + hlen + plen
+    if view.nbytes < end:
+        raise FrameDesync(
+            f"truncated frame: have {view.nbytes} bytes, need {end}")
+    hdr_bytes = view[_PREFIX.size:_PREFIX.size + hlen]
+    if zlib.crc32(hdr_bytes) != hcrc:
+        raise FrameDesync("frame header CRC mismatch")
+    try:
+        header = json.loads(bytes(hdr_bytes))
+    except ValueError as e:
+        raise FrameDesync(f"frame header is not JSON: {e}") from e
+    payload = view[_PREFIX.size + hlen:end]
+    if zlib.crc32(payload) != pcrc:
+        raise FrameCorrupt("frame payload CRC mismatch", header=header)
+    return header, payload
+
+
+def _flatten_state(state, prefix: str = "") -> list:
+    """Deterministic (sorted-key, '/'-joined path) flatten of a handle
+    state tree into ``[(path, leaf), ...]``.  List/tuple nodes (e.g.
+    per-layer cache stacks) use ``#i``/``@i`` index segments so the
+    receiver rebuilds the exact container types."""
+    out = []
+    if isinstance(state, dict):
+        items = [(str(k), state[k]) for k in sorted(state)]
+    elif isinstance(state, (list, tuple)):
+        marker = "#" if isinstance(state, list) else "@"
+        items = [(f"{marker}{i}", v) for i, v in enumerate(state)]
+    else:
+        raise TypeError(f"unsupported state node {type(state).__name__}")
+    for k, v in items:
+        path = f"{prefix}{k}"
+        if isinstance(v, (dict, list, tuple)):
+            out.extend(_flatten_state(v, prefix=path + "/"))
+        else:
+            out.append((path, v))
+    return out
+
+
+def _unflatten_state(pairs) -> dict:
+    tree: dict = {}
+    for path, leaf in pairs:
+        node = tree
+        *parents, last = path.split("/")
+        for p in parents:
+            node = node.setdefault(p, {})
+        node[last] = leaf
+    return _rebuild_containers(tree)
+
+
+def _rebuild_containers(node):
+    """Turn ``#i``/``@i``-keyed dicts from :func:`_unflatten_state` back
+    into lists/tuples, depth-first."""
+    if not isinstance(node, dict):
+        return node
+    rebuilt = {k: _rebuild_containers(v) for k, v in node.items()}
+    if rebuilt and all(k[:1] in "#@" and k[1:].isdigit() for k in rebuilt):
+        marker = next(iter(rebuilt))[0]
+        seq = [rebuilt[f"{marker}{i}"] for i in range(len(rebuilt))]
+        return tuple(seq) if marker == "@" else seq
+    return rebuilt
+
+
+def request_to_wire(r, *, now: float | None = None) -> dict:
+    """Host-side request row for a frame header.  ``perf_counter``
+    instants don't cross processes, so an absolute deadline travels as
+    its REMAINING budget (mirrors ``ServingEngine._snap_request``)."""
+    entry = {
+        "uid": r.uid,
+        "tokens": [int(t) for t in r.tokens],
+        "max_new_tokens": int(r.max_new_tokens),
+        "top_k": None if r.top_k is None else int(r.top_k),
+        "temperature": float(r.temperature),
+        "seed": int(r.seed),
+    }
+    deadline = r.deadline
+    if deadline is None and r.ttl is not None:
+        deadline = r.submit_time + r.ttl
+    if deadline is not None:
+        if now is None:
+            now = time.perf_counter()
+        entry["deadline_remaining"] = max(0.0, deadline - now)
+    return entry
+
+
+def request_from_wire(d: dict, *, now: float | None = None,
+                      on_complete=None):
+    """Rebuild a :class:`~progen_tpu.decode.engine.Request` in the
+    receiving process; the deadline resumes from its remaining budget."""
+    from progen_tpu.decode.engine import Request
+
+    if now is None:
+        now = time.perf_counter()
+    r = Request(
+        uid=d["uid"], tokens=list(d["tokens"]),
+        max_new_tokens=int(d["max_new_tokens"]),
+        top_k=d.get("top_k"), temperature=float(d.get("temperature", 1.0)),
+        seed=int(d.get("seed", 0)), on_complete=on_complete,
+        submit_time=now)
+    if "deadline_remaining" in d:
+        r.deadline = now + float(d["deadline_remaining"])
+    return r
+
+
+def serialize_handle(handle: Handle, *, extra_header: dict | None = None,
+                     counters=None) -> bytes:
+    """One prefill product → one wire frame.
+
+    A single batched ``device_get`` pulls the whole state tree to host
+    (one sync, not one per leaf), each leaf is appended at its manifest
+    offset, and the header records ``(path, dtype, shape, offset,
+    nbytes)`` so the receiver can rebuild the tree with zero-copy
+    ``np.frombuffer`` views.  ``extra_header`` keys (batch ids, routing
+    tags) are merged into the header verbatim.
+    """
+    import jax
+    import numpy as np
+
+    t0 = time.perf_counter()
+    pairs = _flatten_state(handle.state)
+    host = jax.device_get([leaf for _, leaf in pairs])
+    manifest = []
+    parts = []
+    off = 0
+    for (path, _), arr in zip(pairs, host):
+        arr = np.ascontiguousarray(arr)
+        manifest.append([path, str(arr.dtype), list(arr.shape), off,
+                         arr.nbytes])
+        # uint8 reinterpret: extension dtypes (bfloat16) reject the
+        # buffer protocol directly
+        parts.append(memoryview(arr.reshape(-1).view(np.uint8)))
+        off += arr.nbytes
+    header = {
+        "type": "handle",
+        "p_pad": int(handle.p_pad),
+        "reqs": [request_to_wire(r) for r in handle.requests],
+        "manifest": manifest,
+    }
+    if extra_header:
+        header.update(extra_header)
+    frame = pack_frame(header, parts)
+    if counters is not None:
+        counters.ser_s += time.perf_counter() - t0
+    return frame
+
+
+def _np_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)  # bfloat16 resolves via jax's ml_dtypes
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def deserialize_handle(buf, *, header: dict | None = None,
+                       payload=None, counters=None) -> Handle:
+    """One wire frame → a :class:`Handle` of fresh device arrays.
+
+    Pass either the full frame ``buf`` or a pre-unpacked ``(header,
+    payload)`` pair (the router parses headers without touching
+    payloads).  Each manifest entry becomes an ``np.frombuffer`` view
+    into the single received buffer — no host-side copy — and one
+    batched ``device_put`` commits the tree to device, producing fresh
+    buffers the decode merge can safely DONATE.
+    """
+    import jax
+    import numpy as np
+
+    t0 = time.perf_counter()
+    if header is None:
+        header, payload = unpack_frame(buf)
+    view = memoryview(payload).cast("B")
+    pairs = []
+    try:
+        for path, dtype, shape, off, nbytes in header["manifest"]:
+            arr = np.frombuffer(view[off:off + nbytes],
+                                dtype=_np_dtype(dtype)).reshape(shape)
+            pairs.append((path, arr))
+        reqs = [request_from_wire(d) for d in header["reqs"]]
+        p_pad = int(header["p_pad"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise FrameCorrupt(f"malformed handle header: {e}",
+                           header=header) from e
+    state = _unflatten_state(
+        zip([p for p, _ in pairs],
+            jax.device_put([a for _, a in pairs])))
+    h = Handle(requests=reqs, state=state, p_pad=p_pad)
+    if counters is not None:
+        counters.de_s += time.perf_counter() - t0
+    return h

@@ -30,6 +30,21 @@ import sys
 from progen_tpu.observe.gitinfo import git_sha
 
 
+def stamp_record(record: dict | None = None, **extra) -> dict:
+    """The one door every benchmark JSON record leaves through.
+
+    Merges ``extra`` into a copy of ``record`` and guarantees the
+    ``git_sha`` stamp, so a record can always be traced back to the code
+    that produced it.  Callers pass their fields and never touch
+    :func:`~progen_tpu.observe.gitinfo.git_sha` directly —
+    ``tests/test_observe.py`` sweeps the bench sources to keep it that
+    way."""
+    out = dict(record or {})
+    out.update(extra)
+    out.setdefault("git_sha", git_sha())
+    return out
+
+
 def emit_error_record(e: BaseException, **extra) -> None:
     """One parseable JSON error line (stdout, rc stays 0) with a platform
     stamp — the driver ingests this instead of a traceback.  ``extra``

@@ -14,6 +14,7 @@ from progen_tpu.resilience.retry import (
     retriable,
     retry_call,
 )
+from progen_tpu.resilience.supervise import StageEvent, StageSupervisor
 from progen_tpu.resilience.watchdog import (
     WATCHDOG_EXIT_CODE,
     FlightRecorder,
@@ -26,6 +27,8 @@ __all__ = [
     "FlightRecorder",
     "RetryError",
     "RetryPolicy",
+    "StageEvent",
+    "StageSupervisor",
     "WATCHDOG_EXIT_CODE",
     "Watchdog",
     "default_classifier",

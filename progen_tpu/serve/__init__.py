@@ -1,0 +1,34 @@
+"""Multi-process serving runtime (docs/SERVING.md §7).
+
+PR 8 disaggregated prefill from decode inside one process; this package
+moves the stages into separate OS processes, each with its own JAX
+runtime:
+
+- **prefill workers** run the bucketed prefill programs and serialize
+  the resulting :class:`~progen_tpu.decode.handoff.Handle`\\ s onto a
+  host-side socket transport (``decode/handoff.py`` wire format);
+- **decode replicas** deserialize handles into the existing donating
+  merge via :meth:`ServingEngine.admit_handle` and stream completions
+  home;
+- the **router** (in the driver process) spreads requests across the
+  prefill fleet and handles across R decode replicas
+  (least-outstanding-tokens), sheds on deadlines, relays ack credits,
+  and — with the resilience layer's :class:`StageSupervisor` — restarts
+  a dead stage and replays its in-flight requests (per-request seed
+  determinism makes the replay token-identical).
+
+Placement is invisible in the tokens: a multi-process cluster produces
+bit-identical completions to the single-process engine on the same
+request set, greedy and sampled (``tests/test_serve_multiproc.py``).
+"""
+
+from progen_tpu.serve.cluster import ServeCluster
+from progen_tpu.serve.router import Router
+from progen_tpu.serve.worker import build_engine_from_spec, make_spec
+
+__all__ = [
+    "Router",
+    "ServeCluster",
+    "build_engine_from_spec",
+    "make_spec",
+]

@@ -1,0 +1,464 @@
+"""ServeCluster: spawn, route, supervise the multi-process topology.
+
+The cluster lives in the DRIVER process (bench, ``sample.py --serve
+--serve_procs``, tests) and owns:
+
+- the listener socket plus one :class:`Peer` per worker (reader threads
+  push events onto one queue — the transport side, allowed to sync);
+- the :class:`Router` policy state (admission side, must NOT sync —
+  host-sync zone in ``analysis/rules_hostsync.py``);
+- the :class:`StageSupervisor` restart budget.
+
+Failure semantics (chaos-tested): a dead stage maps to exactly the
+requests whose work it held; those are re-dispatched through the normal
+path — a replay is token-identical by per-request seed determinism —
+or shed as typed ``FAILED_FAULT`` completions when the stage cannot
+come back.  Survivor requests never notice.  Corrupt handle frames
+(payload CRC) are reported by the replica and replayed the same way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from progen_tpu.decode.engine import (
+    FAILED_FAULT,
+    SHED_DEADLINE,
+    Completion,
+    Request,
+)
+from progen_tpu.decode.handoff import request_to_wire
+from progen_tpu.observe.transport import TransportCounters
+from progen_tpu.resilience.supervise import StageSupervisor
+from progen_tpu.serve.router import Router
+from progen_tpu.serve.transport import Peer
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def _completion_from_wire(header: dict, submit_time: float,
+                          finish_time: float) -> Completion:
+    """Wire message → Completion (module-level: builds numpy arrays, so
+    it stays OUTSIDE the cluster's host-sync zone)."""
+    return Completion(
+        uid=header["uid"],
+        prime=np.asarray(header.get("prime", []), np.int32),
+        tokens=np.asarray(header.get("tokens", []), np.int32),
+        finish_reason=header["finish_reason"],
+        submit_time=submit_time, finish_time=finish_time,
+        status=header.get("status", "ok"))
+
+
+def _shed_completion(request, status: str, now: float) -> Completion:
+    return Completion(
+        uid=request.uid,
+        prime=np.asarray(list(request.tokens), np.int32),
+        tokens=np.asarray([], np.int32),
+        finish_reason=status, submit_time=request.submit_time,
+        finish_time=now, status=status)
+
+
+def _deadline_of(request) -> float | None:
+    if request.deadline is not None:
+        return request.deadline
+    if request.ttl is not None:
+        return request.submit_time + request.ttl
+    return None
+
+
+class ServeCluster:
+    """N prefill workers + R decode replicas behind one router."""
+
+    def __init__(self, spec: dict, *, prefill_procs: int = 1,
+                 replicas: int = 1, supervisor: StageSupervisor | None = None,
+                 spawn_timeout: float = 300.0, stale_after: float = 300.0,
+                 log_dir: str | None = None):
+        self.spec = spec
+        self.prefill_procs = prefill_procs
+        self.replicas = replicas
+        self.supervisor = supervisor or StageSupervisor(max_restarts=1)
+        self.stale_after = stale_after
+        self.counters = TransportCounters()  # router-side, all peers
+        self.router = Router(prefill_procs, replicas)
+        self.completions: dict = {}          # uid -> Completion
+        self._new: list[Completion] = []
+        self._events: _queue.Queue = _queue.Queue()
+        self._peers: dict = {}               # (role, idx) -> Peer
+        self._procs: dict = {}               # (role, idx) -> Popen
+        self._handled_dead: set = set()
+        self._respawning: set = set()
+        self._parked_uids: list = []
+        self._worker_stats: dict = {}
+        self._hb: dict = {}
+        self._shutting_down = False
+
+        self._tmp = tempfile.TemporaryDirectory(prefix="progen_serve_")
+        self.log_dir = Path(log_dir) if log_dir else Path(self._tmp.name)
+        self._spec_path = Path(self._tmp.name) / "spec.json"
+        self._spec_path.write_text(json.dumps(spec))
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(prefill_procs + replicas + 4)
+        self.port = self._listener.getsockname()[1]
+        self._accepting = True
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          daemon=True, name="serve-accept")
+        self._acceptor.start()
+
+        try:
+            for i in range(prefill_procs):
+                self._spawn("prefill", i)
+            for i in range(replicas):
+                self._spawn("decode", i)
+            self._wait_workers(spawn_timeout)
+        except Exception:
+            self.shutdown(collect_stats=False)
+            raise
+
+    # ------------------------------------------------------------- processes
+
+    def _worker_env(self) -> dict:
+        env = dict(os.environ)
+        # each worker is its own single-device JAX runtime; strip the
+        # parent's virtual-device / pod topology hints (pattern of
+        # __graft_entry__'s respawn)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("TPU_WORKER_HOSTNAMES", None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append("--xla_force_host_platform_device_count=1")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(_REPO_ROOT)] + ([env["PYTHONPATH"]]
+                                 if env.get("PYTHONPATH") else []))
+        return env
+
+    def _spawn(self, role: str, idx: int) -> None:
+        log_path = self.log_dir / f"{role}_{idx}.log"
+        log = open(log_path, "a")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "progen_tpu.serve.worker",
+             role, str(idx), str(self.port), str(self._spec_path)],
+            env=self._worker_env(), stdout=log, stderr=subprocess.STDOUT,
+            cwd=str(_REPO_ROOT))
+        log.close()
+        self._procs[(role, idx)] = proc
+
+    def _accept_loop(self) -> None:
+        while self._accepting:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            peer = Peer(sock, self.counters)
+            peer.start_reader(self._events)
+
+    def _log_tail(self, role: str, idx: int, n: int = 30) -> str:
+        path = self.log_dir / f"{role}_{idx}.log"
+        try:
+            lines = path.read_text().splitlines()
+        except OSError:
+            return "<no log>"
+        return "\n".join(lines[-n:])
+
+    def _wait_workers(self, timeout: float) -> None:
+        """Pump until every spawned worker said hello."""
+        deadline = time.perf_counter() + timeout
+        want = self.prefill_procs + self.replicas
+        while len(self._peers) < want:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"cluster handshake timed out: {len(self._peers)}/"
+                    f"{want} workers connected")
+            for (role, idx), proc in self._procs.items():
+                if proc.poll() is not None and (role, idx) not in self._peers:
+                    raise RuntimeError(
+                        f"worker {role}:{idx} exited rc={proc.returncode} "
+                        f"before hello\n--- log tail ---\n"
+                        f"{self._log_tail(role, idx)}")
+            self._pump(0.2)
+
+    def kill_worker(self, role: str, idx: int) -> None:
+        """SIGKILL a stage instance (chaos testing)."""
+        proc = self._procs.get((role, idx))
+        if proc is not None and proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+
+    # -------------------------------------------------------------- frontend
+
+    def submit(self, request: Request) -> None:
+        """Route one request to a prefill worker; deadline- and
+        availability-sheds produce typed completions, never raises for
+        operational conditions (mirrors ``ServingEngine.submit``)."""
+        if request.uid in self.router.requests:
+            raise ValueError(f"duplicate uid {request.uid!r}")
+        self._pump(0.0)
+        now = time.perf_counter()
+        self.router.requests[request.uid] = request
+        self.router.submit_times[request.uid] = now
+        self._dispatch(request.uid, now)
+
+    def _dispatch(self, uid, now: float) -> None:
+        request = self.router.requests[uid]
+        deadline = _deadline_of(request)
+        if deadline is not None and now > deadline:
+            self._shed(uid, SHED_DEADLINE, now)
+            return
+        w = self.router.pick_prefill()
+        if w is None:
+            if any(k[0] == "prefill" for k in self._respawning):
+                self._parked_uids.append(uid)
+                return
+            self._shed(uid, FAILED_FAULT, now)
+            return
+        self.router.assign_prefill(uid, request, w, now)
+        peer = self._peers.get(("prefill", w))
+        if peer is None or not peer.alive:
+            # raced a death the event queue has not surfaced yet; the
+            # dead-peer path will pick the uid up via fail_worker
+            return
+        peer.send_json({"type": "req",
+                        "req": request_to_wire(request, now=now)})
+
+    def _shed(self, uid, status: str, now: float) -> None:
+        request = self.router.requests[uid]
+        if not self.router.complete(uid):
+            return
+        comp = _shed_completion(request, status, now)
+        self.completions[uid] = comp
+        self._new.append(comp)
+
+    def poll(self, timeout: float = 0.0) -> list[Completion]:
+        """Process transport events for up to ``timeout`` seconds;
+        returns completions that arrived since the last poll."""
+        self._pump(timeout)
+        out, self._new = self._new, []
+        return out
+
+    @property
+    def pending(self) -> int:
+        return len(self.router.requests) - len(self.router.completed)
+
+    def drain(self, timeout: float = 600.0) -> list[Completion]:
+        """Block until every submitted request has completed (served or
+        typed-shed); returns ALL completions sorted by uid."""
+        deadline = time.perf_counter() + timeout
+        while self.pending > 0:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"cluster drain timed out with {self.pending} "
+                    f"request(s) open; router={self.router.stats()}")
+            self._pump(0.1)
+        return [self.completions[uid] for uid in self.router.requests
+                if uid in self.completions]
+
+    # ------------------------------------------------------------ event loop
+
+    def _pump(self, timeout: float) -> None:
+        block = timeout > 0.0
+        deadline = time.perf_counter() + timeout
+        while True:
+            try:
+                if block:
+                    wait = max(0.0, deadline - time.perf_counter())
+                    ev = self._events.get(timeout=wait) if wait else \
+                        self._events.get_nowait()
+                else:
+                    ev = self._events.get_nowait()
+            except _queue.Empty:
+                break
+            block = False  # block at most once per pump
+            self._handle_event(ev)
+        self._check_stale()
+
+    def _handle_event(self, ev) -> None:
+        kind, peer = ev[0], ev[1]
+        if kind == "dead":
+            self._on_peer_dead(peer, ev[2])
+            return
+        header, frame = ev[2], ev[3]
+        t = header.get("type")
+        if t == "hello":
+            self._on_hello(peer, header)
+        elif t == "hb":
+            self._hb[(peer.role, peer.index)] = header
+        elif t == "ready":
+            pass  # informational; first traffic may already be queued
+        elif t == "handle":
+            self._on_handle(peer, header, frame)
+        elif t == "ack":
+            src = self.router.ack(header.get("batch_id"))
+            if src is not None:
+                p = self._peers.get(("prefill", src))
+                if p is not None and p.alive:
+                    p.send_json({"type": "ack",
+                                 "batch_id": header.get("batch_id")})
+        elif t == "bad_frame":
+            # payload CRC failed at the replica: typed recovery — the
+            # named requests replay through the normal path
+            now = time.perf_counter()
+            for uid in self.router.requeue(header.get("uids", [])):
+                self._dispatch(uid, now)
+        elif t == "completion":
+            uid = header.get("uid")
+            if self.router.complete(uid):
+                comp = _completion_from_wire(
+                    header, self.router.submit_times.get(uid, 0.0),
+                    time.perf_counter())
+                self.completions[uid] = comp
+                self._new.append(comp)
+        elif t == "stats":
+            self._worker_stats[(peer.role, peer.index)] = header
+
+    def _on_hello(self, peer: Peer, header: dict) -> None:
+        # index arrives as a JSON int from the worker's hello; no cast —
+        # the wire header is parsed host data, and this method sits in a
+        # host-sync zone where casts on unproven values flag
+        role, idx = header.get("role"), header.get("index", -1)
+        peer.role, peer.index = role, idx
+        self._peers[(role, idx)] = peer
+        if (role, idx) in self._respawning:
+            self._respawning.discard((role, idx))
+            self._handled_dead.discard((role, idx))
+            self.router.revive_worker(role, idx)
+            parked, self._parked_uids = self._parked_uids, []
+            now = time.perf_counter()
+            for uid in parked:
+                self._dispatch(uid, now)
+
+    def _on_handle(self, peer: Peer, header: dict, frame: bytes) -> None:
+        batch_id = header.get("batch_id")
+        uids = [d["uid"] for d in header.get("reqs", [])]
+        self.router.note_handle(batch_id, uids, peer.index)
+        r = self.router.pick_replica()
+        if r is None:
+            now = time.perf_counter()
+            if any(k[0] == "decode" for k in self._respawning):
+                # replica stage is coming back: send the requests back
+                # through prefill once it does
+                self._parked_uids.extend(self.router.requeue(uids))
+            else:
+                for uid in self.router.requeue(uids):
+                    self._shed(uid, FAILED_FAULT, now)
+            return
+        self.router.forward(batch_id, r)
+        rp = self._peers.get(("decode", r))
+        if rp is not None and rp.alive:
+            rp.send_bytes(frame)  # verbatim relay: payload is zero-copy
+
+    def _on_peer_dead(self, peer: Peer, reason: str) -> None:
+        if peer.role is None or self._shutting_down:
+            return
+        key = (peer.role, peer.index)
+        if key in self._handled_dead:
+            return
+        self._handled_dead.add(key)
+        proc = self._procs.get(key)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        peer.close()
+        if self._peers.get(key) is peer:
+            del self._peers[key]
+
+        affected = self.router.fail_worker(peer.role, peer.index)
+        if self.supervisor.request_restart(peer.role, peer.index, reason):
+            self._respawning.add(key)
+            self._parked_uids.extend(
+                u for u in affected if u not in self._parked_uids)
+            self._spawn(peer.role, peer.index)
+            # a live sibling can absorb parked work right away
+            now = time.perf_counter()
+            if (peer.role == "prefill" and self.router.prefill_alive) or \
+                    (peer.role == "decode" and self.router.prefill_alive):
+                parked, self._parked_uids = self._parked_uids, []
+                for uid in parked:
+                    self._dispatch(uid, now)
+        else:
+            now = time.perf_counter()
+            for uid in affected:
+                self._dispatch(uid, now)  # sheds if the stage is gone
+
+    def _check_stale(self) -> None:
+        if self._shutting_down:
+            return
+        now = time.perf_counter()
+        for key, peer in list(self._peers.items()):
+            if peer.alive and now - peer.last_seen > self.stale_after:
+                self._events.put(("dead", peer,
+                                  f"heartbeat stale > {self.stale_after}s"))
+                peer.alive = False
+
+    # --------------------------------------------------------------- teardown
+
+    def shutdown(self, *, collect_stats: bool = True,
+                 timeout: float = 30.0) -> dict:
+        """Stop the fleet: shutdown messages, final stats collection,
+        join (then kill) every child.  Returns :meth:`stats`."""
+        self._shutting_down = True
+        for peer in list(self._peers.values()):
+            if peer.alive:
+                peer.send_json({"type": "shutdown"})
+        if collect_stats:
+            deadline = time.perf_counter() + timeout
+            want = set(self._peers)
+            while not want.issubset(self._worker_stats):
+                if time.perf_counter() > deadline:
+                    break
+                self._pump(0.1)
+        self._accepting = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for key, proc in self._procs.items():
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+        for peer in list(self._peers.values()):
+            peer.close()
+        out = self.stats()
+        self._tmp.cleanup()
+        return out
+
+    # ------------------------------------------------------------------ stats
+
+    def stats(self) -> dict:
+        """Aggregated cluster record fields: router policy state, the
+        per-worker stats messages (stage seconds, transport counters,
+        queue depths), the router's own transport counters, and the
+        supervision history."""
+        total = TransportCounters()
+        total.merge(self.counters)
+        per_worker = {}
+        for (role, idx), st in sorted(self._worker_stats.items()):
+            per_worker[f"{role}:{idx}"] = {
+                k: v for k, v in st.items() if k != "type"}
+            if "transport" in st:
+                total.merge(st["transport"])
+        return {
+            "topology": {"prefill_procs": self.prefill_procs,
+                         "replicas": self.replicas},
+            "router": self.router.stats(),
+            "router_transport": self.counters.as_dict(),
+            "transport_total": total.as_dict(),
+            "workers": per_worker,
+            "supervision": self.supervisor.stats(),
+        }

@@ -1,0 +1,173 @@
+"""Router policy: request → prefill worker, handle → decode replica.
+
+Pure host-side bookkeeping, deliberately free of sockets and JAX so the
+placement/failure logic is unit-testable (``tests/test_serve_multiproc.py``)
+and syncs are structurally impossible — the module sits inside a
+graftcheck host-sync zone (``analysis/rules_hostsync.py``).
+
+Policy:
+
+- requests go to the LEAST-LOADED live prefill worker (queued-request
+  count — prefill cost is per request, not per token);
+- handles go to the LEAST-OUTSTANDING-TOKENS live replica (the decode
+  budget a replica is still on the hook for: sum of ``max_new_tokens``
+  forwarded minus completed), the closest proxy for remaining decode
+  work without a device sync;
+- every request's stage is tracked (``prefill → handle → replica``), so
+  a dead stage maps to exactly the uids whose work it held:
+  :meth:`fail_worker` returns them for replay (seed determinism makes
+  replays token-identical) or typed shedding — never an exception.
+"""
+
+from __future__ import annotations
+
+
+class Router:
+    """Placement + lifecycle bookkeeping for one serving cluster."""
+
+    def __init__(self, prefill_workers: int, replicas: int):
+        if prefill_workers < 1 or replicas < 1:
+            raise ValueError("need at least one prefill worker and one "
+                             "replica")
+        self.prefill_alive = set(range(prefill_workers))
+        self.replica_alive = set(range(replicas))
+        self.prefill_load = {w: 0 for w in range(prefill_workers)}
+        self.outstanding = {r: 0 for r in range(replicas)}
+        self.requests: dict = {}          # uid -> Request
+        self.stage: dict = {}             # uid -> ("prefill"|"handle"|"replica", key)
+        self.batches: dict = {}           # batch_id -> {uids, src, replica}
+        self.completed: set = set()
+        self.submit_times: dict = {}      # uid -> router perf_counter instant
+        self.max_prefill_queue = 0
+        self.max_outstanding = 0
+
+    # ------------------------------------------------------------- placement
+
+    def pick_prefill(self) -> int | None:
+        """Least queued-requests live prefill worker; None when the
+        whole stage is down (caller sheds)."""
+        if not self.prefill_alive:
+            return None
+        return min(sorted(self.prefill_alive),
+                   key=lambda w: self.prefill_load[w])
+
+    def pick_replica(self) -> int | None:
+        """Least-outstanding-tokens live replica."""
+        if not self.replica_alive:
+            return None
+        return min(sorted(self.replica_alive),
+                   key=lambda r: self.outstanding[r])
+
+    # ------------------------------------------------------------- lifecycle
+
+    def assign_prefill(self, uid, request, worker: int, now: float) -> None:
+        self.requests[uid] = request
+        self.submit_times.setdefault(uid, now)
+        self.stage[uid] = ("prefill", worker)
+        self.prefill_load[worker] += 1
+        self.max_prefill_queue = max(self.max_prefill_queue,
+                                     self.prefill_load[worker])
+
+    def note_handle(self, batch_id: str, uids, src: int) -> None:
+        """A prefill worker shipped a handle covering ``uids``."""
+        self.batches[batch_id] = {"uids": list(uids), "src": src,
+                                  "replica": None}
+        for uid in uids:
+            if self.stage.get(uid, (None,))[0] == "prefill":
+                self.prefill_load[src] = max(
+                    0, self.prefill_load[src] - 1)
+            self.stage[uid] = ("handle", batch_id)
+
+    def forward(self, batch_id: str, replica: int) -> None:
+        """The router relayed the handle frame to ``replica``."""
+        b = self.batches[batch_id]
+        b["replica"] = replica
+        for uid in b["uids"]:
+            if uid in self.completed:
+                continue
+            self.stage[uid] = ("replica", replica)
+            r = self.requests[uid]
+            self.outstanding[replica] += int(r.max_new_tokens)
+        self.max_outstanding = max(self.max_outstanding,
+                                   self.outstanding[replica])
+
+    def ack(self, batch_id: str) -> int | None:
+        """Replica admitted the batch; returns the producing worker so
+        the cluster can relay the credit."""
+        b = self.batches.get(batch_id)
+        return None if b is None else b["src"]
+
+    def complete(self, uid) -> bool:
+        """Record a completion; False if ``uid`` already completed (a
+        replayed duplicate — identical by determinism, dropped)."""
+        if uid in self.completed or uid not in self.requests:
+            return False
+        self.completed.add(uid)
+        kind, key = self.stage.pop(uid, (None, None))
+        if kind == "prefill":
+            self.prefill_load[key] = max(0, self.prefill_load[key] - 1)
+        elif kind == "replica":
+            r = self.requests[uid]
+            self.outstanding[key] = max(
+                0, self.outstanding[key] - int(r.max_new_tokens))
+        return True
+
+    def requeue(self, uids) -> list:
+        """Clear stage bookkeeping for ``uids`` (bad frame / dead stage)
+        so the cluster can re-dispatch them; returns the live subset."""
+        out = []
+        for uid in uids:
+            if uid in self.completed or uid not in self.requests:
+                continue
+            kind, key = self.stage.pop(uid, (None, None))
+            if kind == "prefill":
+                self.prefill_load[key] = max(0, self.prefill_load[key] - 1)
+            elif kind == "replica":
+                r = self.requests[uid]
+                self.outstanding[key] = max(
+                    0, self.outstanding[key] - int(r.max_new_tokens))
+            out.append(uid)
+        return out
+
+    # --------------------------------------------------------------- failure
+
+    def fail_worker(self, role: str, index: int) -> list:
+        """Mark a stage instance dead; returns the uids whose work it
+        held (stage bookkeeping cleared, ready for re-dispatch or typed
+        shedding).  Handles already relayed onward are NOT affected —
+        their work left the dead process."""
+        affected = []
+        if role == "prefill":
+            self.prefill_alive.discard(index)
+            for uid, (kind, key) in self.stage.items():
+                if kind == "prefill" and key == index:
+                    affected.append(uid)
+        else:
+            self.replica_alive.discard(index)
+            for uid, (kind, key) in self.stage.items():
+                if kind == "replica" and key == index:
+                    affected.append(uid)
+            self.outstanding[index] = 0
+        return self.requeue(affected)
+
+    def revive_worker(self, role: str, index: int) -> None:
+        if role == "prefill":
+            self.prefill_alive.add(index)
+            self.prefill_load[index] = 0
+        else:
+            self.replica_alive.add(index)
+            self.outstanding[index] = 0
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        return {
+            "prefill_alive": sorted(self.prefill_alive),
+            "replica_alive": sorted(self.replica_alive),
+            "prefill_load": dict(self.prefill_load),
+            "outstanding_tokens": dict(self.outstanding),
+            "max_prefill_queue": self.max_prefill_queue,
+            "max_outstanding_tokens": self.max_outstanding,
+            "submitted": len(self.requests),
+            "completed": len(self.completed),
+        }

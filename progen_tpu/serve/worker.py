@@ -1,0 +1,278 @@
+"""Serving worker process: ``python -m progen_tpu.serve.worker``.
+
+One process per stage instance, spawned by :class:`ServeCluster` with
+``JAX_PLATFORMS``/``XLA_FLAGS`` pinned so each worker owns its own JAX
+runtime (pattern of ``tests/_multihost_worker.py``).  The worker
+connects back to the router, says hello, builds its engine from the
+JSON spec file, and enters its stage loop:
+
+- ``prefill``: requests in → :meth:`ServingEngine.run_prefill_round` →
+  serialized handle frames out, throttled by an ack credit window (the
+  replica acks on admission; unacked handles ≤ the engine's
+  ``handoff_depth``) so prefilled state never piles up un-merged;
+- ``decode``: handle frames in → :func:`deserialize_handle` →
+  :meth:`ServingEngine.admit_handle` (``remote_prefill=True``: the
+  engine NEVER runs its own prefill — prefill wall leaves this process
+  entirely) → completion messages out.
+
+Every process builds bit-identical params from the same spec (same
+init seed, same jit recipe — or the same checkpoint), so handles made
+by any worker merge into any replica and trajectories depend only on
+(params, prime, seed, knobs): placement is invisible in the tokens.
+
+A payload-CRC-corrupt handle frame is reported home as a typed
+``bad_frame`` message (the router replays the named requests); a
+desynced stream ends the process, and stage supervision restarts it.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import sys
+import time
+from collections import deque
+
+from progen_tpu.core.cache import honor_env_platforms
+
+honor_env_platforms()
+
+
+def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
+              checkpoint_path: str | None = None, draft: str = "identity",
+              engine: dict | None = None, draft_config=None,
+              heartbeat_s: float = 1.0) -> dict:
+    """Build the JSON-able worker spec.  ``engine`` holds
+    :class:`ServingEngine` kwargs (slots/chunk/paged/spec/...);
+    ``disagg`` is implied.  Params come from ``checkpoint_path`` when
+    set, else from ``jit(model.init)(key(init_seed))`` — identical in
+    every process either way."""
+    spec = {
+        "config": config.to_dict(),
+        "mixed_precision": bool(mixed_precision),
+        "init_seed": int(init_seed),
+        "checkpoint_path": checkpoint_path,
+        "draft": draft,
+        "engine": dict(engine or {}),
+        "heartbeat_s": float(heartbeat_s),
+    }
+    if draft_config is not None:
+        spec["draft_config"] = draft_config.to_dict()
+    return spec
+
+
+def build_engine_from_spec(spec: dict, *, remote_prefill: bool = False):
+    """Construct the ServingEngine a worker spec describes — also used
+    by tests/benches to build the in-process REFERENCE engine with the
+    exact same param recipe, making token-identity a hard assert."""
+    import jax
+    import jax.numpy as jnp
+
+    from progen_tpu.core.precision import make_policy
+    from progen_tpu.decode import ServingEngine
+    from progen_tpu.models import ProGen, ProGenConfig
+    from progen_tpu.parallel import unbox
+
+    cfg = ProGenConfig.from_dict(spec["config"])
+    policy = make_policy(bool(spec.get("mixed_precision", True)))
+    model = ProGen(config=cfg, policy=policy)
+    toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
+    if spec.get("checkpoint_path"):
+        from progen_tpu.checkpoint import CheckpointStore, abstract_params_like
+
+        store = CheckpointStore(spec["checkpoint_path"])
+        params = {"params": store.restore_params(
+            abstract_params_like(model, toks))}
+        store.close()
+    else:
+        params = unbox(jax.jit(model.init)(
+            jax.random.key(int(spec.get("init_seed", 0))), toks))
+    kw = dict(spec.get("engine", {}))
+    kw["disagg"] = True
+    if kw.get("spec") and "draft_config" in spec:
+        kw["draft_config"] = ProGenConfig.from_dict(spec["draft_config"])
+    return ServingEngine(cfg, params, policy=policy,
+                         remote_prefill=remote_prefill, **kw)
+
+
+def _completion_to_wire(c) -> dict:
+    return {
+        "type": "completion",
+        "uid": c.uid,
+        "prime": [int(t) for t in c.prime],
+        "tokens": [int(t) for t in c.tokens],
+        "finish_reason": c.finish_reason,
+        "status": c.status,
+        "worker_latency": float(c.latency),
+    }
+
+
+def _drain_inbox(inbox, *, timeout: float):
+    """Pull every queued event (blocking up to ``timeout`` for the
+    first); returns (messages, router_dead)."""
+    out = []
+    t = timeout
+    while True:
+        try:
+            item = inbox.get(timeout=t)
+        except _queue.Empty:
+            return out, False
+        t = 0.0
+        if item[0] == "dead":
+            return out, True
+        out.append((item[2], item[3]))  # (header, frame)
+
+
+def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
+                  window: int) -> None:
+    from progen_tpu.decode.handoff import (
+        request_from_wire,
+        serialize_handle,
+    )
+
+    unacked: set = set()
+    batch_seq = 0
+    running = True
+    last_hb = time.perf_counter()
+    while running or eng.pending:
+        idle = not (eng.pending and len(unacked) < window)
+        msgs, dead = _drain_inbox(inbox, timeout=0.1 if idle else 0.0)
+        if dead:
+            return
+        for header, _ in msgs:
+            t = header.get("type")
+            if t == "req":
+                eng.submit(request_from_wire(header["req"]))
+            elif t == "ack":
+                unacked.discard(header.get("batch_id"))
+            elif t == "shutdown":
+                running = False
+        for c in eng.drain_sheds():
+            peer.send_json(_completion_to_wire(c))
+        while eng.pending and len(unacked) < window:
+            before = eng.pending
+            h = eng.run_prefill_round()
+            for c in eng.drain_sheds():
+                peer.send_json(_completion_to_wire(c))
+            if h is not None:
+                batch_id = f"{peer.index}:{batch_seq}"
+                batch_seq += 1
+                frame = serialize_handle(
+                    h, counters=counters,
+                    extra_header={"batch_id": batch_id,
+                                  "src": peer.index})
+                unacked.add(batch_id)
+                peer.send_bytes(frame)
+            elif eng.pending >= before:
+                break  # no progress (should not happen; avoid spinning)
+        now = time.perf_counter()
+        if now - last_hb >= heartbeat_s:
+            last_hb = now
+            peer.send_json({
+                "type": "hb", "queue": eng.pending,
+                "unacked": len(unacked),
+                "stage_seconds": eng.stage_seconds})
+    peer.send_json({"type": "stats",
+                    "stage_seconds": eng.stage_seconds,
+                    "transport": counters.as_dict(),
+                    "chunks_run": eng.chunks_run})
+
+
+def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
+    from progen_tpu.decode.handoff import FrameCorrupt, deserialize_handle
+
+    backlog: deque = deque()  # [header, frame, handle|None]
+    running = True
+    max_backlog = 0
+    last_hb = time.perf_counter()
+    while running or eng.has_work or backlog:
+        idle = not (eng.has_work or backlog)
+        msgs, dead = _drain_inbox(inbox, timeout=0.1 if idle else 0.0)
+        if dead:
+            return
+        for header, frame in msgs:
+            t = header.get("type")
+            if t == "handle":
+                backlog.append([header, frame, None])
+                max_backlog = max(max_backlog, len(backlog))
+            elif t == "shutdown":
+                running = False
+        while backlog:
+            entry = backlog[0]
+            if entry[2] is None:
+                try:
+                    entry[2] = deserialize_handle(entry[1],
+                                                  counters=counters)
+                except FrameCorrupt:
+                    counters.crc_failures += 1
+                    backlog.popleft()
+                    peer.send_json({
+                        "type": "bad_frame",
+                        "batch_id": entry[0].get("batch_id"),
+                        "uids": [d["uid"]
+                                 for d in entry[0].get("reqs", [])]})
+                    continue
+            if not eng.admit_handle(entry[2]):
+                break  # handoff at depth: step() below frees it
+            backlog.popleft()
+            peer.send_json({"type": "ack",
+                            "batch_id": entry[0].get("batch_id")})
+        if eng.has_work:
+            for c in eng.step():
+                peer.send_json(_completion_to_wire(c))
+        now = time.perf_counter()
+        if now - last_hb >= heartbeat_s:
+            last_hb = now
+            peer.send_json({
+                "type": "hb", "inflight": eng.num_active,
+                "handoff_backlog": len(backlog),
+                "stage_seconds": eng.stage_seconds})
+    peer.send_json({"type": "stats",
+                    "stage_seconds": eng.stage_seconds,
+                    "transport": counters.as_dict(),
+                    "chunks_run": eng.chunks_run,
+                    "max_handoff_backlog": max_backlog,
+                    "robust": eng.robustness_counters()})
+
+
+def main(argv) -> int:
+    role, index, port, spec_path = (
+        argv[0], int(argv[1]), int(argv[2]), argv[3])
+    from progen_tpu.core.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    with open(spec_path) as fh:
+        spec = json.load(fh)
+
+    from progen_tpu.observe.transport import TransportCounters
+    from progen_tpu.serve.transport import Peer, connect
+
+    counters = TransportCounters()
+    sock = connect(port)
+    peer = Peer(sock, counters)
+    peer.role, peer.index = role, index
+    peer.send_json({"type": "hello", "role": role, "index": index})
+
+    print(f"worker {role}:{index} building engine", flush=True)
+    t0 = time.perf_counter()
+    eng = build_engine_from_spec(spec, remote_prefill=(role == "decode"))
+    print(f"worker {role}:{index} engine ready in "
+          f"{time.perf_counter() - t0:.1f}s", flush=True)
+    peer.send_json({"type": "ready", "build_s": time.perf_counter() - t0})
+
+    inbox: _queue.Queue = _queue.Queue()
+    peer.start_reader(inbox)
+    hb = float(spec.get("heartbeat_s", 1.0))
+    if role == "prefill":
+        window = max(1, int(spec.get("engine", {}).get("handoff_depth", 2)))
+        _prefill_loop(eng, peer, inbox, counters,
+                      heartbeat_s=hb, window=window)
+    else:
+        _decode_loop(eng, peer, inbox, counters, heartbeat_s=hb)
+    print(f"worker {role}:{index} exiting", flush=True)
+    peer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

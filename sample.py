@@ -72,6 +72,18 @@ honor_env_platforms()
                    "into decode slots via a bounded handoff queue, so long "
                    "prefills no longer stall in-flight decode "
                    "(docs/SERVING.md)")
+@click.option("--serve_procs", is_flag=True,
+              help="with --serve: multi-process serving — spawn prefill "
+                   "worker and decode replica SUBPROCESSES (each its own "
+                   "JAX runtime) behind a router; cache handles cross "
+                   "processes as CRC-framed zero-copy frames "
+                   "(docs/SERVING.md §7). Workers rebuild the model from "
+                   "this checkpoint, so output is token-identical to the "
+                   "in-process engine")
+@click.option("--prefill_procs", default=1,
+              help="prefill worker processes (with --serve_procs)")
+@click.option("--replicas", default=1,
+              help="decode replica processes (with --serve_procs)")
 @click.option("--watchdog_timeout", default=None, type=float,
               help="engine: seconds without a completed serve step before "
                    "the watchdog dumps all-thread stacks to CWD and exits "
@@ -83,7 +95,8 @@ honor_env_platforms()
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
          seq_len, mesh_spec, strategies, serve, slots, chunk, paged,
          page_size, serve_attempts, snapshot_path, aot_warmup,
-         spec, spec_k, disagg, watchdog_timeout, compile_cache):
+         spec, spec_k, disagg, serve_procs, prefill_procs, replicas,
+         watchdog_timeout, compile_cache):
     import os
 
     import jax
@@ -148,6 +161,43 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
         from progen_tpu.resilience import Watchdog
 
         primes = prime.split("|") if "|" in prime else [prime] * num_samples
+        requests = []
+        for i, p in enumerate(primes):
+            toks = [0] + encode_tokens(p)  # BOS-prefixed, like add_bos
+            requests.append(Request(
+                uid=i, tokens=toks, max_new_tokens=seq_len - len(toks),
+                top_k=top_k, temperature=temperature, seed=seed + i))
+
+        if serve_procs:
+            if mesh_spec is not None:
+                raise click.BadParameter(
+                    "--mesh shards ONE process's decode over devices; "
+                    "--serve_procs spawns single-device worker processes — "
+                    "pick one", param_hint="--serve_procs")
+            from progen_tpu.serve import ServeCluster, make_spec
+
+            # workers rebuild bit-identical params by restoring this same
+            # checkpoint, so cluster output matches the in-process engine
+            wspec = make_spec(
+                model_config, mixed_precision=True,
+                checkpoint_path=os.path.abspath(checkpoint_path),
+                engine=dict(num_slots=slots, chunk_size=chunk,
+                            max_len=seq_len, paged=paged,
+                            page_size=page_size, spec=spec, spec_k=spec_k))
+            cluster = ServeCluster(wspec, prefill_procs=prefill_procs,
+                                   replicas=replicas)
+            try:
+                for r in requests:
+                    cluster.submit(r)
+                completions = cluster.drain()
+            finally:
+                cluster.shutdown()
+            for comp in sorted(completions, key=lambda c: c.uid):
+                print(f"\n {primes[comp.uid]} \n", "*" * 40,
+                      f"[{comp.finish_reason}, {len(comp.tokens)} tokens, "
+                      f"{comp.latency:.2f}s]\n", decode_tokens(comp.tokens))
+            return
+
         watchdog = None
         if watchdog_timeout:
             watchdog = Watchdog(watchdog_timeout, out_dir=".",
@@ -168,12 +218,6 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                       f"{stats['seconds']:.1f}s")
             return eng
 
-        requests = []
-        for i, p in enumerate(primes):
-            toks = [0] + encode_tokens(p)  # BOS-prefixed, like add_bos
-            requests.append(Request(
-                uid=i, tokens=toks, max_new_tokens=seq_len - len(toks),
-                top_k=top_k, temperature=temperature, seed=seed + i))
         try:
             completions = run_with_restarts(
                 engine_factory, requests, attempts=serve_attempts,

@@ -79,3 +79,34 @@ def test_dryrun_multichip_completes_without_tpu():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dryrun_multichip ok" in proc.stdout
     assert "mesh(" in proc.stdout
+    # MULTICHIP_r05 regression: the child prints per-phase progress so a
+    # hang names its phase instead of dying as an opaque rc=124
+    for phase in ("provision_devices", "build_mesh",
+                  "trace_train_functions", "init_state", "train_step"):
+        assert f"dryrun phase={phase} start" in proc.stdout, proc.stdout
+        assert f"dryrun phase={phase} ok" in proc.stdout, proc.stdout
+
+
+def test_phase_watchdog_emits_structured_error(monkeypatch):
+    """A phase that outlives its budget must die with one JSON error line
+    naming the phase and rc=3 — never a silent outer-timeout kill.  Run in
+    a child so the watchdog's os._exit doesn't take pytest down."""
+    code = (
+        "import os; os.environ['%s']='0.2'\n"
+        "import __graft_entry__ as ge, time\n"
+        "with ge._phase('stall'):\n"
+        "    time.sleep(30)\n" % ge._PHASE_TIMEOUT_ENV
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60, cwd=os.path.dirname(ge.__file__),
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stdout, proc.stderr)
+    assert "dryrun phase=stall start" in proc.stdout
+    assert "dryrun phase=stall ok" not in proc.stdout
+    err = [ln for ln in proc.stdout.splitlines()
+           if ln.startswith('{"dryrun_error"')]
+    assert err, proc.stdout
+    payload = __import__("json").loads(err[0])
+    assert payload == {"dryrun_error": "phase_timeout", "phase": "stall",
+                       "budget_s": 0.2}

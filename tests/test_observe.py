@@ -128,3 +128,43 @@ def test_meter_rebase_excludes_hook_time():
     # without rebase the 80ms stall would drag the rate to ~2000/0.12;
     # with it both intervals are ~20ms of train time
     assert m.tokens_per_sec == pytest.approx(2000 / 0.04, rel=0.5)
+
+
+def test_stamp_record_sets_git_sha_and_merges():
+    from progen_tpu.observe.gitinfo import git_sha
+    from progen_tpu.observe.platform import stamp_record
+
+    rec = stamp_record({"bench": "x", "n": 3}, platform="cpu")
+    assert rec["bench"] == "x" and rec["n"] == 3
+    assert rec["platform"] == "cpu"
+    assert rec["git_sha"] == git_sha()
+    # caller-provided sha wins (e.g. replaying an archived record)
+    assert stamp_record({"git_sha": "abc"})["git_sha"] == "abc"
+    # input dict is not mutated
+    src = {"a": 1}
+    stamp_record(src)
+    assert src == {"a": 1}
+
+
+def test_every_bench_record_emitter_uses_stamp_record():
+    """Source sweep: every benchmark that emits JSON records must route
+    them through observe.platform.stamp_record, so git_sha can never be
+    forgotten on a new record schema."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    benches = [os.path.join(repo, "bench.py")] + sorted(
+        os.path.join(repo, "benchmarks", f)
+        for f in os.listdir(os.path.join(repo, "benchmarks"))
+        if f.startswith("bench_") and f.endswith(".py")
+    )
+    assert len(benches) >= 7  # bench.py + the benchmarks/ drivers
+    for path in benches:
+        src = open(path).read()
+        if "json.dumps(" not in src:
+            continue
+        assert "stamp_record" in src, (
+            f"{os.path.basename(path)} emits JSON records without "
+            "observe.platform.stamp_record (git_sha stamp)")
+        # nobody bypasses the helper to stamp by hand
+        assert "git_sha()" not in src, os.path.basename(path)

@@ -432,8 +432,11 @@ def test_bench_records_carry_git_sha():
 
     sha = git_sha()
     assert sha and all(c in "0123456789abcdef" for c in sha)
+    # stamping goes through the one door (observe.platform.stamp_record,
+    # which setdefaults git_sha); tests/test_observe.py sweeps EVERY
+    # bench source for compliance — here just pin the serving benches
     root = pathlib.Path(__file__).resolve().parents[1]
     for script in ("benchmarks/bench_coldstart.py",
                    "benchmarks/bench_serving.py"):
         src = (root / script).read_text()
-        assert '"git_sha": git_sha()' in src, script
+        assert "stamp_record" in src, script

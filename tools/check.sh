@@ -47,6 +47,16 @@ JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
     --max-new 6 --prime-min 4 --prime-max 12 \
     --spec --spec-k 2 --disagg --verify
 
+echo "== multiproc-serving smoke =="
+# real 2-process disaggregated cluster (prefill worker + decode replica
+# subprocesses behind the router) with --verify: asserts the cluster's
+# completions are token-identical to the in-process engine AND that a
+# fresh cluster replay reproduces them exactly (docs/SERVING.md §7)
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 4 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --serve-procs --verify
+
 echo "== superstep quick-bench smoke =="
 # tiny-shape K-sweep on CPU: proves the fused dispatch path runs end to
 # end and emits parseable JSON (full sweep: benchmarks/superstep.md)
