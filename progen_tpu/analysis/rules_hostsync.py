@@ -80,15 +80,15 @@ HOT_ZONES: tuple[Zone, ...] = (
     Zone(r"serve/router\.py$", r"Router\..*$",
          frozenset({"prefill_alive", "replica_alive", "prefill_load",
                     "outstanding", "requests", "stage", "batches",
-                    "completed", "submit_times", "max_prefill_queue",
-                    "max_outstanding"})),
+                    "_uid_batch", "completed", "submit_times",
+                    "max_prefill_queue", "max_outstanding"})),
     # the cluster's ADMISSION/event side must not sync (wire headers are
     # parsed JSON; numpy-building lives in module helpers outside the
     # zone); spawn/accept/log plumbing is transport-side and unzoned
     Zone(r"serve/cluster\.py$",
          r"ServeCluster\.(submit|_dispatch|_shed|poll|pending|drain"
          r"|_pump|_handle_event|_on_hello|_on_handle|_on_peer_dead"
-         r"|_check_stale)$",
+         r"|_return_credit|_check_stale)$",
          frozenset({"router", "completions", "supervisor", "counters",
                     "_new", "_events", "_peers", "_procs",
                     "_handled_dead", "_respawning", "_parked_uids",

@@ -97,6 +97,7 @@ class ServeCluster:
         self._events: _queue.Queue = _queue.Queue()
         self._peers: dict = {}               # (role, idx) -> Peer
         self._procs: dict = {}               # (role, idx) -> Popen
+        self._incarnations: dict = {}        # (role, idx) -> spawn count
         self._handled_dead: set = set()
         self._respawning: set = set()
         self._parked_uids: list = []
@@ -148,11 +149,18 @@ class ServeCluster:
         return env
 
     def _spawn(self, role: str, idx: int) -> None:
+        # the incarnation nonce rides in every batch id the worker
+        # mints: a respawn restarts batch_seq at 0, and without the
+        # nonce its ids would collide with the dead incarnation's
+        # entries still in the router's bookkeeping
+        inc = self._incarnations.get((role, idx), 0)
+        self._incarnations[(role, idx)] = inc + 1
         log_path = self.log_dir / f"{role}_{idx}.log"
         log = open(log_path, "a")
         proc = subprocess.Popen(
             [sys.executable, "-m", "progen_tpu.serve.worker",
-             role, str(idx), str(self.port), str(self._spec_path)],
+             role, str(idx), str(self.port), str(self._spec_path),
+             str(inc)],
             env=self._worker_env(), stdout=log, stderr=subprocess.STDOUT,
             cwd=str(_REPO_ROOT))
         log.close()
@@ -298,19 +306,18 @@ class ServeCluster:
         elif t == "hb":
             self._hb[(peer.role, peer.index)] = header
         elif t == "ready":
-            pass  # informational; first traffic may already be queued
+            # staleness starts here: until ready, the worker is inside
+            # its engine build (cold jit can run minutes heartbeat-free)
+            peer.ready = True
         elif t == "handle":
             self._on_handle(peer, header, frame)
         elif t == "ack":
-            src = self.router.ack(header.get("batch_id"))
-            if src is not None:
-                p = self._peers.get(("prefill", src))
-                if p is not None and p.alive:
-                    p.send_json({"type": "ack",
-                                 "batch_id": header.get("batch_id")})
+            self._return_credit(header.get("batch_id"))
         elif t == "bad_frame":
             # payload CRC failed at the replica: typed recovery — the
-            # named requests replay through the normal path
+            # batch's credit goes home and the named requests replay
+            # through the normal path
+            self._return_credit(header.get("batch_id"))
             now = time.perf_counter()
             for uid in self.router.requeue(header.get("uids", [])):
                 self._dispatch(uid, now)
@@ -341,12 +348,31 @@ class ServeCluster:
             for uid in parked:
                 self._dispatch(uid, now)
 
+    def _return_credit(self, batch_id) -> None:
+        """Relay one ack credit to the prefill worker that produced
+        ``batch_id``.  Called on replica admission AND on every path
+        that drops or requeues a noted batch instead (bad frame, dead
+        replica, no replica to forward to) — otherwise the producer's
+        unacked window leaks a slot per event and the worker stops
+        producing handles after ``handoff_depth`` of them.  The router
+        yields each batch's credit exactly once, so the drop paths and
+        a late replica ack cannot double-grant."""
+        src = self.router.ack(batch_id)
+        if src is None:
+            return
+        p = self._peers.get(("prefill", src))
+        if p is not None and p.alive:
+            p.send_json({"type": "ack", "batch_id": batch_id})
+
     def _on_handle(self, peer: Peer, header: dict, frame: bytes) -> None:
         batch_id = header.get("batch_id")
         uids = [d["uid"] for d in header.get("reqs", [])]
         self.router.note_handle(batch_id, uids, peer.index)
         r = self.router.pick_replica()
         if r is None:
+            # this batch will never reach replica admission: return its
+            # credit before parking/shedding the member requests
+            self._return_credit(batch_id)
             now = time.perf_counter()
             if any(k[0] == "decode" for k in self._respawning):
                 # replica stage is coming back: send the requests back
@@ -375,6 +401,11 @@ class ServeCluster:
         if self._peers.get(key) is peer:
             del self._peers[key]
 
+        if peer.role == "decode":
+            # batches forwarded to the dead replica but never admitted:
+            # their acks will never arrive, so return each credit now
+            for bid in self.router.unacked_batches(peer.index):
+                self._return_credit(bid)
         affected = self.router.fail_worker(peer.role, peer.index)
         if self.supervisor.request_restart(peer.role, peer.index, reason):
             self._respawning.add(key)
@@ -398,6 +429,12 @@ class ServeCluster:
             return
         now = time.perf_counter()
         for key, peer in list(self._peers.items()):
+            # a peer is exempt until its "ready" frame: engine build
+            # sends no heartbeats, and a cold jit compile exceeding
+            # stale_after must not burn restart budget on a healthy
+            # worker (a build that dies still EOFs its socket)
+            if not peer.ready:
+                continue
             if peer.alive and now - peer.last_seen > self.stale_after:
                 self._events.put(("dead", peer,
                                   f"heartbeat stale > {self.stale_after}s"))

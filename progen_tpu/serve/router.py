@@ -35,7 +35,8 @@ class Router:
         self.outstanding = {r: 0 for r in range(replicas)}
         self.requests: dict = {}          # uid -> Request
         self.stage: dict = {}             # uid -> ("prefill"|"handle"|"replica", key)
-        self.batches: dict = {}           # batch_id -> {uids, src, replica}
+        self.batches: dict = {}           # batch_id -> {uids, src, replica, acked, open}
+        self._uid_batch: dict = {}        # uid -> batch_id it last rode in
         self.completed: set = set()
         self.submit_times: dict = {}      # uid -> router perf_counter instant
         self.max_prefill_queue = 0
@@ -69,10 +70,15 @@ class Router:
                                      self.prefill_load[worker])
 
     def note_handle(self, batch_id: str, uids, src: int) -> None:
-        """A prefill worker shipped a handle covering ``uids``."""
+        """A prefill worker shipped a handle covering ``uids``.  The
+        batch entry lives until its credit is returned (:meth:`ack`)
+        AND every member uid has resolved (completed or requeued) —
+        then it is pruned, so long-running clusters don't grow."""
         self.batches[batch_id] = {"uids": list(uids), "src": src,
-                                  "replica": None}
+                                  "replica": None, "acked": False,
+                                  "open": set(uids)}
         for uid in uids:
+            self._uid_batch[uid] = batch_id
             if self.stage.get(uid, (None,))[0] == "prefill":
                 self.prefill_load[src] = max(
                     0, self.prefill_load[src] - 1)
@@ -92,10 +98,42 @@ class Router:
                                    self.outstanding[replica])
 
     def ack(self, batch_id: str) -> int | None:
-        """Replica admitted the batch; returns the producing worker so
-        the cluster can relay the credit."""
+        """Return the batch's credit: marks it acked and returns the
+        producing worker so the cluster can relay the grant — None for
+        an unknown OR already-acked batch.  Each batch yields exactly
+        one credit ever, whether it came from replica admission or from
+        a drop path (bad frame, dead replica, no replica to forward
+        to), so a duplicate or late ack can never leak a grant."""
         b = self.batches.get(batch_id)
-        return None if b is None else b["src"]
+        if b is None or b["acked"]:
+            return None
+        b["acked"] = True
+        src = b["src"]
+        self._drop_batch_if_done(batch_id)
+        return src
+
+    def unacked_batches(self, replica: int) -> list:
+        """Batch ids forwarded to ``replica`` whose admission ack never
+        came back — when the replica dies, each still pins one credit
+        of its producer's window until the cluster returns it."""
+        return [bid for bid, b in self.batches.items()
+                if b["replica"] == replica and not b["acked"]]
+
+    def _drop_batch_if_done(self, batch_id) -> None:
+        b = self.batches.get(batch_id)
+        if b is not None and b["acked"] and not b["open"]:
+            del self.batches[batch_id]
+
+    def _leave_batch(self, uid) -> None:
+        """``uid`` resolved (completed or requeued): release its seat in
+        the batch it last rode in, pruning the entry once empty+acked."""
+        batch_id = self._uid_batch.pop(uid, None)
+        if batch_id is None:
+            return
+        b = self.batches.get(batch_id)
+        if b is not None:
+            b["open"].discard(uid)
+            self._drop_batch_if_done(batch_id)
 
     def complete(self, uid) -> bool:
         """Record a completion; False if ``uid`` already completed (a
@@ -110,6 +148,7 @@ class Router:
             r = self.requests[uid]
             self.outstanding[key] = max(
                 0, self.outstanding[key] - int(r.max_new_tokens))
+        self._leave_batch(uid)
         return True
 
     def requeue(self, uids) -> list:
@@ -126,6 +165,7 @@ class Router:
                 r = self.requests[uid]
                 self.outstanding[key] = max(
                     0, self.outstanding[key] - int(r.max_new_tokens))
+            self._leave_batch(uid)
             out.append(uid)
         return out
 
@@ -168,6 +208,7 @@ class Router:
             "outstanding_tokens": dict(self.outstanding),
             "max_prefill_queue": self.max_prefill_queue,
             "max_outstanding_tokens": self.max_outstanding,
+            "open_batches": len(self.batches),
             "submitted": len(self.requests),
             "completed": len(self.completed),
         }

@@ -107,6 +107,12 @@ def connect(port: int, *, host: str = "127.0.0.1", timeout: float = 60.0,
     while time.perf_counter() < deadline:
         try:
             sock = socket.create_connection((host, port), timeout=timeout)
+            # the timeout bounds the CONNECT attempt only: the reader
+            # thread blocks in recv() across idle lulls (a prefill
+            # worker between requests, a replica mid-decode), and an
+            # inherited timeout would surface there as a spurious peer
+            # death after the first quiet minute
+            sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return sock
         except OSError as e:
@@ -126,6 +132,10 @@ class Peer:
         self.role: str | None = None
         self.index: int | None = None
         self.alive = True
+        # set by the cluster when the worker's "ready" frame arrives;
+        # staleness is not judged before then (engine build sends no
+        # heartbeats and a cold jit compile can take minutes)
+        self.ready = False
         self.last_seen = time.perf_counter()
         self._send_lock = threading.Lock()
         self._reader: threading.Thread | None = None

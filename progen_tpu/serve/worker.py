@@ -124,7 +124,7 @@ def _drain_inbox(inbox, *, timeout: float):
 
 
 def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
-                  window: int) -> None:
+                  window: int, incarnation: int = 0) -> None:
     from progen_tpu.decode.handoff import (
         request_from_wire,
         serialize_handle,
@@ -155,7 +155,10 @@ def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
             for c in eng.drain_sheds():
                 peer.send_json(_completion_to_wire(c))
             if h is not None:
-                batch_id = f"{peer.index}:{batch_seq}"
+                # the incarnation nonce keeps a respawned worker's ids
+                # (batch_seq restarts at 0) distinct from any the dead
+                # incarnation left in the router's bookkeeping
+                batch_id = f"{peer.index}.{incarnation}:{batch_seq}"
                 batch_seq += 1
                 frame = serialize_handle(
                     h, counters=counters,
@@ -238,6 +241,7 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
 def main(argv) -> int:
     role, index, port, spec_path = (
         argv[0], int(argv[1]), int(argv[2]), argv[3])
+    incarnation = int(argv[4]) if len(argv) > 4 else 0
     from progen_tpu.core.cache import enable_compilation_cache
 
     enable_compilation_cache()
@@ -266,7 +270,8 @@ def main(argv) -> int:
     if role == "prefill":
         window = max(1, int(spec.get("engine", {}).get("handoff_depth", 2)))
         _prefill_loop(eng, peer, inbox, counters,
-                      heartbeat_s=hb, window=window)
+                      heartbeat_s=hb, window=window,
+                      incarnation=incarnation)
     else:
         _decode_loop(eng, peer, inbox, counters, heartbeat_s=hb)
     print(f"worker {role}:{index} exiting", flush=True)
