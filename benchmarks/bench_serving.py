@@ -81,7 +81,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from progen_tpu.observe.meter import profile_trace
+from progen_tpu.observe.metrics import latency_percentiles
 from progen_tpu.observe.platform import probe_backend, stamp_record
+from progen_tpu.observe.trace import (
+    configure_tracing,
+    get_tracer,
+    merge_trace_dir,
+    trace_dump_path,
+)
 
 
 def main() -> None:
@@ -186,6 +194,16 @@ def main() -> None:
                          "then snapshot/restore replay-parity assert")
     ap.add_argument("--out", metavar="FILE", default=None,
                     help="also append the record to this JSONL file")
+    ap.add_argument("--trace", action="store_true",
+                    help="record request spans in every process and merge "
+                         "them into one Perfetto trace.json under "
+                         "--trace-out (see docs/OBSERVABILITY.md)")
+    ap.add_argument("--trace-out", metavar="DIR", default="trace_out",
+                    help="directory for per-process trace dumps and the "
+                         "merged trace.json (with --trace)")
+    ap.add_argument("--xprof-dir", metavar="DIR", default=None,
+                    help="record an xprof/TensorBoard profile of the "
+                         "measured drive into this directory")
     ap.add_argument("--compile_cache", metavar="DIR", default=None,
                     help="JAX persistent compilation cache dir ('0' "
                          "disables); overrides PROGEN_COMPILE_CACHE")
@@ -199,6 +217,10 @@ def main() -> None:
 
     if not probe_backend(metric="serving"):
         return
+
+    if args.trace:
+        os.makedirs(args.trace_out, exist_ok=True)
+        configure_tracing(enabled=True, process="driver")
 
     from progen_tpu.core.precision import make_policy
     from progen_tpu.decode import Request, ServingEngine
@@ -340,13 +362,17 @@ def main() -> None:
 
     if args.chaos:
         faults.configure(args.faults, seed=args.faults_seed)
-    done, wall, max_in_flight = drive(engine)
+    with profile_trace(args.xprof_dir):
+        done, wall, max_in_flight = drive(engine)
     counters = engine.robustness_counters()  # before the injector disarms
     if args.chaos:
         faults.configure("")
 
     ok = [c for c in done if c.ok]
     latencies = sorted(c.latency for c in ok) or [0.0]
+    # p50/p95 through the shared registry histogram — the same quantile
+    # code path cluster.stats() and traceview --summarize use
+    p50, p95 = latency_percentiles(latencies)
     gen_tokens = int(sum(len(c.tokens) for c in ok))
     from progen_tpu.train.memory import serving_plan
 
@@ -371,8 +397,8 @@ def main() -> None:
         "wall_s": round(wall, 3),
         "generated_tokens": gen_tokens,
         "tokens_per_sec": round(gen_tokens / wall, 1),
-        "p50_latency_s": round(float(np.percentile(latencies, 50)), 3),
-        "p95_latency_s": round(float(np.percentile(latencies, 95)), 3),
+        "p50_latency_s": round(p50, 3),
+        "p95_latency_s": round(p95, 3),
         "chunks_run": engine.chunks_run,
         "platform": jax.devices()[0].platform,
     })
@@ -401,16 +427,16 @@ def main() -> None:
         inline_ok = [c for c in inline_done if c.ok]
         inline_lat = sorted(c.latency for c in inline_ok) or [0.0]
         inline_tok = int(sum(len(c.tokens) for c in inline_ok))
+        i50, i95 = latency_percentiles(inline_lat,
+                                       name="bench.inline_latency_s")
         record.update({
             "disagg": True,
             "prefill_batch": engine.prefill_batch,
             "handoff_depth": args.handoff_depth,
             "handoff": engine._handoff.stats(),
             "tokens_per_sec_inline": round(inline_tok / inline_wall, 1),
-            "p50_latency_s_inline": round(
-                float(np.percentile(inline_lat, 50)), 3),
-            "p95_latency_s_inline": round(
-                float(np.percentile(inline_lat, 95)), 3),
+            "p50_latency_s_inline": round(i50, 3),
+            "p95_latency_s_inline": round(i95, 3),
         })
     if args.paged:
         record.update({
@@ -437,6 +463,12 @@ def main() -> None:
     if args.verify:
         _verify(mk_engine, make_request, done, args)
         record["verified"] = True
+
+    if args.trace:
+        get_tracer().dump(trace_dump_path(args.trace_out, "driver"))
+        merged = merge_trace_dir(args.trace_out)
+        if merged:
+            record["trace"] = merged
 
     line = json.dumps(record)
     print(line, flush=True)
@@ -475,7 +507,9 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
     # recipe, so the workers' params are bit-identical to the in-process
     # comparison engines' — token identity is assertable
     wspec = make_spec(cfg, mixed_precision=True, init_seed=0,
-                      engine=engine_kw, draft_config=draft_config)
+                      engine=engine_kw, draft_config=draft_config,
+                      trace=({"dir": os.path.abspath(args.trace_out)}
+                             if args.trace else None))
 
     def drive_cluster():
         cluster = ServeCluster(wspec, prefill_procs=args.prefill_procs,
@@ -508,9 +542,11 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
             stats = cluster.shutdown()
         return served, wall, stats
 
-    done, wall, stats = drive_cluster()
+    with profile_trace(args.xprof_dir):
+        done, wall, stats = drive_cluster()
     ok = [c for c in done if c.ok]
     lat = sorted(c.latency for c in ok) or [0.0]
+    c50, c95 = latency_percentiles(lat, name="bench.cluster_latency_s")
     gen = int(sum(len(c.tokens) for c in ok))
 
     def rerun(use_disagg: bool):
@@ -520,10 +556,11 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
         r_ok = [c for c in r_done if c.ok]
         r_lat = sorted(c.latency for c in r_ok) or [0.0]
         r_tok = int(sum(len(c.tokens) for c in r_ok))
+        r50, r95 = latency_percentiles(r_lat, name="bench.rerun_latency_s")
         return {
             "tokens_per_sec": round(r_tok / r_wall, 1),
-            "p50_latency_s": round(float(np.percentile(r_lat, 50)), 3),
-            "p95_latency_s": round(float(np.percentile(r_lat, 95)), 3),
+            "p50_latency_s": round(r50, 3),
+            "p95_latency_s": round(r95, 3),
         }
 
     sp_disagg = rerun(use_disagg=True)   # single-process disagg
@@ -548,8 +585,8 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
         "generated_tokens": gen,
         "ok_requests": len(ok),
         "tokens_per_sec": round(gen / wall, 1),
-        "p50_latency_s": round(float(np.percentile(lat, 50)), 3),
-        "p95_latency_s": round(float(np.percentile(lat, 95)), 3),
+        "p50_latency_s": round(c50, 3),
+        "p95_latency_s": round(c95, 3),
         # per-stage wall time per worker: decode replicas must report
         # prefill_s == 0.0 — the prefill wall left the process entirely
         "stage_seconds": {w: st.get("stage_seconds")
@@ -587,6 +624,14 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
         record["verified"] = True
         print("verify: multiproc token-identity and cluster replay "
               "parity OK", file=sys.stderr)
+
+    if args.trace:
+        # every process dumped its span ring (workers at exit, the driver
+        # in cluster.shutdown with its clock-offset meta) — merge them
+        # into one Perfetto-loadable timeline
+        merged = merge_trace_dir(args.trace_out)
+        if merged:
+            record["trace"] = merged
 
     line = json.dumps(record)
     print(line, flush=True)

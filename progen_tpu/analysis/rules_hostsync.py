@@ -39,9 +39,9 @@ class Zone:
 HOT_ZONES: tuple[Zone, ...] = (
     Zone(
         r"train/trainer\.py$",
-        r"Trainer\.(_run_loop|_run_loop_superstep|evaluate)$",
+        r"Trainer\.(_run_loop|_run_loop_superstep|evaluate|_note_phase)$",
         frozenset({"meter", "tracker", "config", "model_config", "store",
-                   "_recorder", "lr_schedule"}),
+                   "_recorder", "_tracer", "lr_schedule"}),
     ),
     Zone(
         r"decode/engine\.py$",
@@ -52,7 +52,7 @@ HOT_ZONES: tuple[Zone, ...] = (
         r"|_dispatch_chunk|_fail_inflight|_activate_xla_fallback"
         r"|_drain_pending|robustness_counters|_prefill_round"
         r"|_admit_from_handoff|_prefill_worker_call|_merge_call"
-        r"|admit_handle|run_prefill_round|drain_sheds)$",
+        r"|admit_handle|run_prefill_round|drain_sheds|_note_stage)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
@@ -63,7 +63,8 @@ HOT_ZONES: tuple[Zone, ...] = (
                    "fault_retries", "max_queue", "shed_policy",
                    "paged_impl", "_watchdog", "_handoff", "disagg",
                    "spec", "spec_k", "prefill_batch", "_max_advance",
-                   "_spec_rounds", "remote_prefill", "stage_seconds"}),
+                   "_spec_rounds", "remote_prefill", "stage_seconds",
+                   "_tracer", "_stage_hist"}),
     ),
     # the page pool is pure host bookkeeping between dispatches: nothing
     # in it may touch a device value, so every sync call is a finding
@@ -88,13 +89,19 @@ HOT_ZONES: tuple[Zone, ...] = (
     Zone(r"serve/cluster\.py$",
          r"ServeCluster\.(submit|_dispatch|_shed|poll|pending|drain"
          r"|_pump|_handle_event|_on_hello|_on_handle|_on_peer_dead"
-         r"|_return_credit|_check_stale)$",
+         r"|_return_credit|_check_stale|_note_clock)$",
          frozenset({"router", "completions", "supervisor", "counters",
                     "_new", "_events", "_peers", "_procs",
                     "_handled_dead", "_respawning", "_parked_uids",
                     "_worker_stats", "_hb", "_shutting_down",
                     "stale_after", "prefill_procs", "replicas",
-                    "spec"})),
+                    "spec", "_tracer", "_lat", "_clock_offsets",
+                    "_stats_age"})),
+    # span recording sits on every hot path above: it must never sync
+    # (spans carry pre-computed floats, never device values)
+    Zone(r"observe/trace\.py$", r"Tracer\.(span|add|event)$"),
+    Zone(r"observe/metrics\.py$",
+         r"(Counter\.inc|Gauge\.set|Histogram\.observe)$"),
     Zone(r"train/step\.py$",
          r".*\.(train_step|_train_step_body|train_multi_step|eval_step)$"),
 )

@@ -84,6 +84,8 @@ import numpy as np
 from jax.sharding import Mesh
 
 from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.observe import metrics as _metrics
+from progen_tpu.observe import trace as _obs_trace
 from progen_tpu.observe.robustness import RobustnessCounters
 from progen_tpu.resilience import faults
 from progen_tpu.resilience.retry import RetryError, default_classifier
@@ -298,6 +300,15 @@ class ServingEngine:
         # LEAVES the decode process (its prefill_s stays 0.0)
         self.stage_seconds = {"prefill_s": 0.0, "merge_s": 0.0,
                               "decode_chunk_s": 0.0}
+        # the same deltas feed the process tracer (no-op unless enabled)
+        # and the shared metrics registry's per-stage latency histograms
+        self._tracer = _obs_trace.get_tracer()
+        registry = _metrics.get_registry()
+        self._stage_hist = {
+            "prefill_s": registry.histogram("engine.prefill_s"),
+            "merge_s": registry.histogram("engine.merge_s"),
+            "decode_chunk_s": registry.histogram("engine.decode_chunk_s"),
+        }
 
         if params_shardings is not None:
             params = jax.device_put(params, {"params": params_shardings})
@@ -434,6 +445,16 @@ class ServingEngine:
         return state
 
     # ------------------------------------------------------ fault containment
+
+    def _note_stage(self, stage: str, span: str, t0: float, **args) -> None:
+        """Fold one guarded device dispatch into every observability
+        surface at once: ``stage_seconds`` (the legacy per-stage wall),
+        the shared metrics histogram, and the trace ring (a no-op span
+        unless tracing is enabled)."""
+        dt = time.perf_counter() - t0
+        self.stage_seconds[stage] += dt
+        self._stage_hist[stage].observe(dt)
+        self._tracer.add(span, t0, dt, **args)
 
     def _guard(self, point: str, fn: Callable | None = None, *args,
                key: tuple | None = None):
@@ -1000,6 +1021,8 @@ class ServingEngine:
                 self._shed(request, SHED_QUEUE_FULL)
                 return
         self._queue.append(request)
+        self._tracer.event("serve.submit", trace=request.uid,
+                           queue=len(self._queue))
 
     @property
     def pending(self) -> int:
@@ -1049,6 +1072,7 @@ class ServingEngine:
             submit_time=r.submit_time, finish_time=time.perf_counter())
         self.completions.append(comp)
         self._pending.append(comp)
+        self._tracer.event("serve.shed", trace=r.uid, status=status)
         if r.on_complete is not None:
             r.on_complete(comp)
         return comp
@@ -1138,10 +1162,12 @@ class ServingEngine:
 
         t0 = time.perf_counter()
         try:
-            self.state = self._guard(
-                "serve.prefill", self._admit_call, tokens, lengths, stops,
-                seeds, top_k, temp, mask, key=("admit", p_pad))
-            self.stage_seconds["prefill_s"] += time.perf_counter() - t0
+            with jax.profiler.TraceAnnotation("serve.admit_prefill"):
+                self.state = self._guard(
+                    "serve.prefill", self._admit_call, tokens, lengths,
+                    stops, seeds, top_k, temp, mask, key=("admit", p_pad))
+            self._note_stage("prefill_s", "serve.admit_prefill", t0,
+                             uids=[r.uid for _, r in batch], p_pad=p_pad)
         except _ContainedFault:
             # the batch's prefill never merged: undo the bookkeeping and
             # shed exactly the requests whose work was lost
@@ -1210,11 +1236,13 @@ class ServingEngine:
 
         t0 = time.perf_counter()
         try:
-            self.state = self._guard(
-                "serve.prefill", self._admit_call, tokens, lengths, stops,
-                seeds, top_k, temp, mask, self._page_table.copy(), wtable,
-                key=("admit", p_pad))
-            self.stage_seconds["prefill_s"] += time.perf_counter() - t0
+            with jax.profiler.TraceAnnotation("serve.admit_prefill"):
+                self.state = self._guard(
+                    "serve.prefill", self._admit_call, tokens, lengths,
+                    stops, seeds, top_k, temp, mask,
+                    self._page_table.copy(), wtable, key=("admit", p_pad))
+            self._note_stage("prefill_s", "serve.admit_prefill", t0,
+                             uids=[r.uid for _, r in batch], p_pad=p_pad)
         except _ContainedFault:
             # prefill never merged: the planned pages hold nothing — free
             # them (no prefix registration was committed, so the index
@@ -1280,11 +1308,13 @@ class ServingEngine:
             temp[row] = float(r.temperature)
         t0 = time.perf_counter()
         try:
-            h = self._guard(
-                "serve.prefill", self._prefill_worker_call, tokens,
-                lengths, stops, seeds, top_k, temp,
-                key=("prefill", p_pad))
-            self.stage_seconds["prefill_s"] += time.perf_counter() - t0
+            with jax.profiler.TraceAnnotation("serve.prefill"):
+                h = self._guard(
+                    "serve.prefill", self._prefill_worker_call, tokens,
+                    lengths, stops, seeds, top_k, temp,
+                    key=("prefill", p_pad))
+            self._note_stage("prefill_s", "serve.prefill", t0,
+                             uids=[r.uid for r in batch], p_pad=p_pad)
         except _ContainedFault:
             for r in batch:
                 self._shed(r, FAILED_FAULT)
@@ -1360,11 +1390,13 @@ class ServingEngine:
                     # retry/requeue-safe because faults.inject raises
                     # BEFORE the jitted program dispatches — a contained
                     # or transient failure here has not consumed them
-                    self.state = self._guard(
-                        "serve.handoff", self._merge_call, h.state, src,
-                        mask, *extra, key=("merge",))
-                    self.stage_seconds["merge_s"] += \
-                        time.perf_counter() - t0
+                    with jax.profiler.TraceAnnotation("serve.merge"):
+                        self.state = self._guard(
+                            "serve.handoff", self._merge_call, h.state,
+                            src, mask, *extra, key=("merge",))
+                    self._note_stage(
+                        "merge_s", "serve.merge", t0,
+                        uids=[r.uid for _, r in live_rows])
                 except _ContainedFault:
                     for slot, r in placed:
                         self._inflight.pop(slot, None)
@@ -1521,6 +1553,7 @@ class ServingEngine:
             self._defer("harvest", e)
             return []
         self._defer_streak.pop("harvest", None)
+        t0 = time.perf_counter()
         # two-phase fetch: one small transfer of the per-slot flags gates
         # the call (the common case is "nothing finished"); the big seq
         # buffer only crosses the wire when some slot actually completed
@@ -1551,6 +1584,8 @@ class ServingEngine:
             act = act.at[i].set(False)
         self.state = {**self.state, "active": act}
         self.completions.extend(out)
+        self._tracer.add("serve.harvest", t0, time.perf_counter() - t0,
+                         uids=[c.uid for c in out])
         return out
 
     def _dispatch_chunk(self) -> None:
@@ -1571,10 +1606,12 @@ class ServingEngine:
         while True:
             t0 = time.perf_counter()
             try:
-                out = self._guard(point, self._chunk_call, *args,
-                                  key=("chunk",))
-                self.stage_seconds["decode_chunk_s"] += \
-                    time.perf_counter() - t0
+                with jax.profiler.TraceAnnotation("serve.decode_chunk"):
+                    out = self._guard(point, self._chunk_call, *args,
+                                      key=("chunk",))
+                self._note_stage(
+                    "decode_chunk_s", "serve.decode_chunk", t0,
+                    uids=[r.uid for r in self._inflight.values()])
                 if self.spec:
                     out, stats = out
                     # lazy device-side accumulation — spec_counters()

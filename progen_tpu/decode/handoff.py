@@ -55,6 +55,8 @@ import zlib
 from collections import deque
 from typing import Any, Sequence
 
+from progen_tpu.observe import trace as _trace
+
 
 @dataclasses.dataclass
 class Handle:
@@ -274,6 +276,8 @@ def request_to_wire(r, *, now: float | None = None) -> dict:
     """Host-side request row for a frame header.  ``perf_counter``
     instants don't cross processes, so an absolute deadline travels as
     its REMAINING budget (mirrors ``ServingEngine._snap_request``)."""
+    if now is None:
+        now = time.perf_counter()
     entry = {
         "uid": r.uid,
         "tokens": [int(t) for t in r.tokens],
@@ -281,13 +285,16 @@ def request_to_wire(r, *, now: float | None = None) -> dict:
         "top_k": None if r.top_k is None else int(r.top_k),
         "temperature": float(r.temperature),
         "seed": int(r.seed),
+        # trace context: the per-request trace id (its uid) plus the
+        # sender's clock instant, so the receiving process can attribute
+        # queue-wait to this request on an offset-corrected timeline
+        # (docs/OBSERVABILITY.md)
+        "trace": {"id": r.uid, "clock": now},
     }
     deadline = r.deadline
     if deadline is None and r.ttl is not None:
         deadline = r.submit_time + r.ttl
     if deadline is not None:
-        if now is None:
-            now = time.perf_counter()
         entry["deadline_remaining"] = max(0.0, deadline - now)
     return entry
 
@@ -348,8 +355,12 @@ def serialize_handle(handle: Handle, *, extra_header: dict | None = None,
     if extra_header:
         header.update(extra_header)
     frame = pack_frame(header, parts)
+    dt = time.perf_counter() - t0
     if counters is not None:
-        counters.ser_s += time.perf_counter() - t0
+        counters.ser_s += dt
+    _trace.get_tracer().add("handoff.serialize", t0, dt,
+                            uids=[r.uid for r in handle.requests],
+                            nbytes=len(frame))
     return frame
 
 
@@ -397,6 +408,9 @@ def deserialize_handle(buf, *, header: dict | None = None,
         zip([p for p, _ in pairs],
             jax.device_put([a for _, a in pairs])))
     h = Handle(requests=reqs, state=state, p_pad=p_pad)
+    dt = time.perf_counter() - t0
     if counters is not None:
-        counters.de_s += time.perf_counter() - t0
+        counters.de_s += dt
+    _trace.get_tracer().add("handoff.deserialize", t0, dt,
+                            uids=[r.uid for r in reqs])
     return h

@@ -6,8 +6,27 @@ from progen_tpu.observe.flops import (
 )
 from progen_tpu.observe.gitinfo import git_sha
 from progen_tpu.observe.meter import ThroughputMeter, profile_trace
+from progen_tpu.observe.metrics import (
+    LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    latency_buckets,
+    latency_percentiles,
+)
 from progen_tpu.observe.platform import emit_error_record, probe_backend
 from progen_tpu.observe.robustness import RobustnessCounters
+from progen_tpu.observe.trace import (
+    Tracer,
+    chrome_trace,
+    configure_tracing,
+    get_tracer,
+    merge_trace_dir,
+    spans_for,
+    trace_dump_path,
+)
 from progen_tpu.observe.tracker import Tracker
 
 __all__ = [
@@ -22,4 +41,21 @@ __all__ = [
     "ThroughputMeter",
     "profile_trace",
     "Tracker",
+    # tracing (observe.trace)
+    "Tracer",
+    "chrome_trace",
+    "configure_tracing",
+    "get_tracer",
+    "merge_trace_dir",
+    "spans_for",
+    "trace_dump_path",
+    # metrics (observe.metrics)
+    "LATENCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "latency_buckets",
+    "latency_percentiles",
 ]

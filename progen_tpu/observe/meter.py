@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+from collections import deque
 
 import jax
 
@@ -30,8 +31,8 @@ class ThroughputMeter:
         self._anchor: float | None = None
         # (duration, tokens, steps) per sync interval — durations are
         # stored, not absolute times, so rebase() can cut hook time out of
-        # the middle of the window
-        self._intervals: list[tuple[float, int, int]] = []
+        # the middle of the window; the deque's maxlen IS the window
+        self._intervals: deque[tuple[float, int, int]] = deque(maxlen=window)
 
     def tick(self, tokens: int, steps: int = 0) -> None:
         """Close the current interval: ``tokens`` (and optionally ``steps``
@@ -40,8 +41,6 @@ class ThroughputMeter:
         now = time.perf_counter()
         if self._anchor is not None:
             self._intervals.append((now - self._anchor, tokens, steps))
-            if len(self._intervals) > self._window:
-                self._intervals.pop(0)
         # the first-ever tick only opens the clock: its tokens include
         # compile time and are never rated
         self._anchor = now
@@ -77,6 +76,24 @@ class ThroughputMeter:
     def tokens_per_sec_per_chip(self) -> float | None:
         tps = self.tokens_per_sec
         return None if tps is None else tps / jax.device_count()
+
+    def snapshot(self) -> dict:
+        """Flat dict of the current rates, for publishing into the metrics
+        registry (``observe.metrics``) or a log record."""
+        return {
+            "tokens_per_sec": self.tokens_per_sec,
+            "steps_per_sec": self.steps_per_sec,
+            "tokens_per_sec_per_chip": self.tokens_per_sec_per_chip,
+            "window": self._window,
+            "intervals": len(self._intervals),
+        }
+
+    def publish(self, registry) -> None:
+        """Set ``meter.*`` gauges on a ``MetricsRegistry`` from the current
+        snapshot (None rates are skipped, not zeroed)."""
+        for key, val in self.snapshot().items():
+            if val is not None:
+                registry.gauge(f"meter.{key}").set(val)
 
 
 @contextlib.contextmanager

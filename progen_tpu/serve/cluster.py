@@ -40,6 +40,8 @@ from progen_tpu.decode.engine import (
     Request,
 )
 from progen_tpu.decode.handoff import request_to_wire
+from progen_tpu.observe import metrics as _metrics
+from progen_tpu.observe import trace as _trace
 from progen_tpu.observe.transport import TransportCounters
 from progen_tpu.resilience.supervise import StageSupervisor
 from progen_tpu.serve.router import Router
@@ -102,7 +104,11 @@ class ServeCluster:
         self._respawning: set = set()
         self._parked_uids: list = []
         self._worker_stats: dict = {}
+        self._stats_age: dict = {}           # (role, idx) -> capture clock
         self._hb: dict = {}
+        self._clock_offsets: dict = {}       # (role, idx) -> min offset (s)
+        self._tracer = _trace.get_tracer()
+        self._lat = _metrics.get_registry().histogram("cluster.latency_s")
         self._shutting_down = False
 
         self._tmp = tempfile.TemporaryDirectory(prefix="progen_serve_")
@@ -220,6 +226,8 @@ class ServeCluster:
         self.router.requests[request.uid] = request
         self.router.submit_times[request.uid] = now
         self._dispatch(request.uid, now)
+        self._tracer.add("cluster.submit", now,
+                         time.perf_counter() - now, trace=request.uid)
 
     def _dispatch(self, uid, now: float) -> None:
         request = self.router.requests[uid]
@@ -235,6 +243,7 @@ class ServeCluster:
             self._shed(uid, FAILED_FAULT, now)
             return
         self.router.assign_prefill(uid, request, w, now)
+        self._tracer.event("cluster.place", trace=uid, worker=w)
         peer = self._peers.get(("prefill", w))
         if peer is None or not peer.alive:
             # raced a death the event queue has not surfaced yet; the
@@ -272,6 +281,20 @@ class ServeCluster:
                     f"cluster drain timed out with {self.pending} "
                     f"request(s) open; router={self.router.stats()}")
             self._pump(0.1)
+        # freshness flush: ask every live worker for a stats/metrics
+        # frame NOW, so post-drain stats() reflects the drained state
+        # rather than the last pre-drain heartbeat snapshot
+        t_req = time.perf_counter()
+        live = [k for k, p in self._peers.items() if p.alive]
+        for k in live:
+            self._peers[k].send_json({"type": "stats_req"})
+        flush_deadline = min(deadline, t_req + 5.0)
+        while any(self._stats_age.get(k, -1.0) < t_req for k in live
+                  if self._peers.get(k) is not None
+                  and self._peers[k].alive):
+            if time.perf_counter() > flush_deadline:
+                break
+            self._pump(0.05)
         return [self.completions[uid] for uid in self.router.requests
                 if uid in self.completions]
 
@@ -304,6 +327,8 @@ class ServeCluster:
         if t == "hello":
             self._on_hello(peer, header)
         elif t == "hb":
+            self._note_clock(peer.role, peer.index, header.get("clock"))
+            header["age_clock"] = time.perf_counter()
             self._hb[(peer.role, peer.index)] = header
         elif t == "ready":
             # staleness starts here: until ready, the worker is inside
@@ -324,13 +349,20 @@ class ServeCluster:
         elif t == "completion":
             uid = header.get("uid")
             if self.router.complete(uid):
-                comp = _completion_from_wire(
-                    header, self.router.submit_times.get(uid, 0.0),
-                    time.perf_counter())
+                now = time.perf_counter()
+                submit = self.router.submit_times.get(uid, 0.0)
+                comp = _completion_from_wire(header, submit, now)
                 self.completions[uid] = comp
                 self._new.append(comp)
+                # the one end-to-end latency code path: the same
+                # histogram bench_serving.py reads its p50/p95 from
+                self._lat.observe(now - submit if submit else 0.0)
+                self._tracer.event("cluster.done", trace=uid,
+                                   latency_s=now - submit)
         elif t == "stats":
+            self._note_clock(peer.role, peer.index, header.get("clock"))
             self._worker_stats[(peer.role, peer.index)] = header
+            self._stats_age[(peer.role, peer.index)] = time.perf_counter()
 
     def _on_hello(self, peer: Peer, header: dict) -> None:
         # index arrives as a JSON int from the worker's hello; no cast —
@@ -339,6 +371,7 @@ class ServeCluster:
         role, idx = header.get("role"), header.get("index", -1)
         peer.role, peer.index = role, idx
         self._peers[(role, idx)] = peer
+        self._note_clock(role, idx, header.get("clock"))
         if (role, idx) in self._respawning:
             self._respawning.discard((role, idx))
             self._handled_dead.discard((role, idx))
@@ -347,6 +380,19 @@ class ServeCluster:
             now = time.perf_counter()
             for uid in parked:
                 self._dispatch(uid, now)
+
+    def _note_clock(self, role, idx, clock) -> None:
+        """Refine the (role, idx) worker's perf_counter offset from a
+        clock echo: offset = driver_receive - worker_send overestimates
+        the true offset by one network delay, so the MINIMUM over all
+        echoes is the tightest causally-safe estimate (driver->worker
+        ordering is preserved; docs/OBSERVABILITY.md)."""
+        if clock is None:
+            return
+        off = time.perf_counter() - clock
+        prev = self._clock_offsets.get((role, idx))
+        if prev is None or off < prev:
+            self._clock_offsets[(role, idx)] = off
 
     def _return_credit(self, batch_id) -> None:
         """Relay one ack credit to the prefill worker that produced
@@ -365,6 +411,7 @@ class ServeCluster:
             p.send_json({"type": "ack", "batch_id": batch_id})
 
     def _on_handle(self, peer: Peer, header: dict, frame: bytes) -> None:
+        t0 = time.perf_counter()
         batch_id = header.get("batch_id")
         uids = [d["uid"] for d in header.get("reqs", [])]
         self.router.note_handle(batch_id, uids, peer.index)
@@ -386,6 +433,8 @@ class ServeCluster:
         rp = self._peers.get(("decode", r))
         if rp is not None and rp.alive:
             rp.send_bytes(frame)  # verbatim relay: payload is zero-copy
+        self._tracer.add("cluster.relay", t0, time.perf_counter() - t0,
+                         uids=uids, batch_id=batch_id, replica=r)
 
     def _on_peer_dead(self, peer: Peer, reason: str) -> None:
         if peer.role is None or self._shutting_down:
@@ -447,13 +496,17 @@ class ServeCluster:
         """Stop the fleet: shutdown messages, final stats collection,
         join (then kill) every child.  Returns :meth:`stats`."""
         self._shutting_down = True
+        t_stop = time.perf_counter()
         for peer in list(self._peers.values()):
             if peer.alive:
                 peer.send_json({"type": "shutdown"})
         if collect_stats:
-            deadline = time.perf_counter() + timeout
+            deadline = t_stop + timeout
             want = set(self._peers)
-            while not want.issubset(self._worker_stats):
+            # wait for stats CAPTURED AFTER the shutdown message — a
+            # drain-time stats_req snapshot must not satisfy this, or the
+            # final flush (complete transport totals) would be skipped
+            while any(self._stats_age.get(k, -1.0) < t_stop for k in want):
                 if time.perf_counter() > deadline:
                     break
                 self._pump(0.1)
@@ -471,9 +524,28 @@ class ServeCluster:
                     proc.wait(timeout=10)
         for peer in list(self._peers.values()):
             peer.close()
+        self.dump_trace()
         out = self.stats()
         self._tmp.cleanup()
         return out
+
+    def dump_trace(self) -> str | None:
+        """Write the driver's span ring (with the per-worker clock
+        offsets as merge metadata) into the spec's trace dir; returns
+        the dump path, or None when tracing is off."""
+        tcfg = self.spec.get("trace")
+        tracer = self._tracer
+        if not (tcfg and tcfg.get("dir") and tracer.enabled):
+            return None
+        tracer.set_meta(offsets={
+            f"{role}:{idx}": off
+            for (role, idx), off in self._clock_offsets.items()})
+        try:
+            return tracer.dump(
+                _trace.trace_dump_path(tcfg["dir"], tracer.process))
+        except OSError as e:
+            print(f"cluster: trace dump failed: {e}", file=sys.stderr)
+            return None
 
     # ------------------------------------------------------------------ stats
 
@@ -482,14 +554,27 @@ class ServeCluster:
         per-worker stats messages (stage seconds, transport counters,
         queue depths), the router's own transport counters, and the
         supervision history."""
+        now = time.perf_counter()
         total = TransportCounters()
         total.merge(self.counters)
         per_worker = {}
         for (role, idx), st in sorted(self._worker_stats.items()):
-            per_worker[f"{role}:{idx}"] = {
-                k: v for k, v in st.items() if k != "type"}
+            entry = {k: v for k, v in st.items() if k != "type"}
+            # monotonic age of this snapshot: 0.0s means "captured just
+            # now" (the drain/shutdown flush), large means stale
+            captured = self._stats_age.get((role, idx))
+            if captured is not None:
+                entry["age_s"] = round(now - captured, 3)
+            per_worker[f"{role}:{idx}"] = entry
             if "transport" in st:
                 total.merge(st["transport"])
+        heartbeats = {}
+        for (role, idx), hb in sorted(self._hb.items()):
+            entry = {k: v for k, v in hb.items() if k != "type"}
+            seen = entry.pop("age_clock", None)
+            if seen is not None:
+                entry["age_s"] = round(now - seen, 3)
+            heartbeats[f"{role}:{idx}"] = entry
         return {
             "topology": {"prefill_procs": self.prefill_procs,
                          "replicas": self.replicas},
@@ -497,5 +582,10 @@ class ServeCluster:
             "router_transport": self.counters.as_dict(),
             "transport_total": total.as_dict(),
             "workers": per_worker,
+            "heartbeats": heartbeats,
+            "metrics": _metrics.get_registry().snapshot(),
+            "clock_offsets": {
+                f"{role}:{idx}": round(off, 6)
+                for (role, idx), off in sorted(self._clock_offsets.items())},
             "supervision": self.supervisor.stats(),
         }

@@ -41,12 +41,15 @@ honor_env_platforms()
 def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
               checkpoint_path: str | None = None, draft: str = "identity",
               engine: dict | None = None, draft_config=None,
-              heartbeat_s: float = 1.0) -> dict:
+              heartbeat_s: float = 1.0, trace: dict | None = None) -> dict:
     """Build the JSON-able worker spec.  ``engine`` holds
     :class:`ServingEngine` kwargs (slots/chunk/paged/spec/...);
     ``disagg`` is implied.  Params come from ``checkpoint_path`` when
     set, else from ``jit(model.init)(key(init_seed))`` — identical in
-    every process either way."""
+    every process either way.  ``trace`` (``{"dir": ..., "capacity"?}``)
+    enables span tracing in every worker; each dumps its ring to
+    ``trace_<role>_<index>.json`` in that directory at exit
+    (docs/OBSERVABILITY.md)."""
     spec = {
         "config": config.to_dict(),
         "mixed_precision": bool(mixed_precision),
@@ -56,6 +59,8 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
         "engine": dict(engine or {}),
         "heartbeat_s": float(heartbeat_s),
     }
+    if trace:
+        spec["trace"] = dict(trace)
     if draft_config is not None:
         spec["draft_config"] = draft_config.to_dict()
     return spec
@@ -123,16 +128,36 @@ def _drain_inbox(inbox, *, timeout: float):
         out.append((item[2], item[3]))  # (header, frame)
 
 
+def _stats_frame(eng, counters, **extra) -> dict:
+    """One stats/metrics frame, sent both as the final flush and in reply
+    to a mid-run ``stats_req`` (the drain-time freshness fix): the worker
+    echoes its clock so the driver can stamp the snapshot's capture age."""
+    from progen_tpu.observe.metrics import get_registry
+
+    msg = {"type": "stats",
+           "clock": time.perf_counter(),
+           "stage_seconds": eng.stage_seconds,
+           "transport": counters.as_dict(),
+           "chunks_run": eng.chunks_run,
+           "metrics": get_registry().snapshot()}
+    msg.update(extra)
+    return msg
+
+
 def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
                   window: int, incarnation: int = 0) -> None:
     from progen_tpu.decode.handoff import (
         request_from_wire,
         serialize_handle,
     )
+    from progen_tpu.observe.metrics import get_registry
+    from progen_tpu.observe.trace import get_tracer
 
+    tracer = get_tracer()
     unacked: set = set()
     batch_seq = 0
     running = True
+    stall_t0 = None  # opened when prefill is blocked on ack credits
     last_hb = time.perf_counter()
     while running or eng.pending:
         idle = not (eng.pending and len(unacked) < window)
@@ -147,6 +172,16 @@ def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
                 unacked.discard(header.get("batch_id"))
             elif t == "shutdown":
                 running = False
+            elif t == "stats_req":
+                peer.send_json(_stats_frame(eng, counters))
+        if eng.pending and len(unacked) >= window:
+            if stall_t0 is None:
+                stall_t0 = time.perf_counter()
+        elif stall_t0 is not None:
+            now = time.perf_counter()
+            tracer.add("worker.credit_stall", stall_t0, now - stall_t0,
+                       queue=eng.pending)
+            stall_t0 = None
         for c in eng.drain_sheds():
             peer.send_json(_completion_to_wire(c))
         while eng.pending and len(unacked) < window:
@@ -163,7 +198,10 @@ def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
                 frame = serialize_handle(
                     h, counters=counters,
                     extra_header={"batch_id": batch_id,
-                                  "src": peer.index})
+                                  "src": peer.index,
+                                  "trace_ctx": {
+                                      "clock": time.perf_counter(),
+                                      "src_proc": f"prefill:{peer.index}"}})
                 unacked.add(batch_id)
                 peer.send_bytes(frame)
             elif eng.pending >= before:
@@ -174,17 +212,19 @@ def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
             peer.send_json({
                 "type": "hb", "queue": eng.pending,
                 "unacked": len(unacked),
-                "stage_seconds": eng.stage_seconds})
-    peer.send_json({"type": "stats",
-                    "stage_seconds": eng.stage_seconds,
-                    "transport": counters.as_dict(),
-                    "chunks_run": eng.chunks_run})
+                "clock": now,
+                "stage_seconds": eng.stage_seconds,
+                "metrics": get_registry().snapshot()})
+    peer.send_json(_stats_frame(eng, counters))
 
 
 def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
     from progen_tpu.decode.handoff import FrameCorrupt, deserialize_handle
+    from progen_tpu.observe.metrics import get_registry
+    from progen_tpu.observe.trace import get_tracer
 
-    backlog: deque = deque()  # [header, frame, handle|None]
+    tracer = get_tracer()
+    backlog: deque = deque()  # [header, frame, handle|None, recv_clock]
     running = True
     max_backlog = 0
     last_hb = time.perf_counter()
@@ -196,10 +236,14 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
         for header, frame in msgs:
             t = header.get("type")
             if t == "handle":
-                backlog.append([header, frame, None])
+                backlog.append([header, frame, None, time.perf_counter()])
                 max_backlog = max(max_backlog, len(backlog))
             elif t == "shutdown":
                 running = False
+            elif t == "stats_req":
+                peer.send_json(_stats_frame(
+                    eng, counters, max_handoff_backlog=max_backlog,
+                    robust=eng.robustness_counters()))
         while backlog:
             entry = backlog[0]
             if entry[2] is None:
@@ -218,6 +262,12 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
             if not eng.admit_handle(entry[2]):
                 break  # handoff at depth: step() below frees it
             backlog.popleft()
+            # queue-wait: frame receipt -> successful admission, tagged
+            # with the uids the handle header names
+            now = time.perf_counter()
+            tracer.add("worker.queue_wait", entry[3], now - entry[3],
+                       uids=[d["uid"] for d in entry[0].get("reqs", [])],
+                       batch_id=entry[0].get("batch_id"))
             peer.send_json({"type": "ack",
                             "batch_id": entry[0].get("batch_id")})
         if eng.has_work:
@@ -229,13 +279,12 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
             peer.send_json({
                 "type": "hb", "inflight": eng.num_active,
                 "handoff_backlog": len(backlog),
-                "stage_seconds": eng.stage_seconds})
-    peer.send_json({"type": "stats",
-                    "stage_seconds": eng.stage_seconds,
-                    "transport": counters.as_dict(),
-                    "chunks_run": eng.chunks_run,
-                    "max_handoff_backlog": max_backlog,
-                    "robust": eng.robustness_counters()})
+                "clock": now,
+                "stage_seconds": eng.stage_seconds,
+                "metrics": get_registry().snapshot()})
+    peer.send_json(_stats_frame(eng, counters,
+                                max_handoff_backlog=max_backlog,
+                                robust=eng.robustness_counters()))
 
 
 def main(argv) -> int:
@@ -248,14 +297,28 @@ def main(argv) -> int:
     with open(spec_path) as fh:
         spec = json.load(fh)
 
+    from progen_tpu.observe.trace import (
+        configure_tracing,
+        get_tracer,
+        trace_dump_path,
+    )
     from progen_tpu.observe.transport import TransportCounters
     from progen_tpu.serve.transport import Peer, connect
+
+    tcfg = spec.get("trace")
+    if tcfg:
+        configure_tracing(enabled=True,
+                          capacity=tcfg.get("capacity"),
+                          process=f"{role}:{index}")
 
     counters = TransportCounters()
     sock = connect(port)
     peer = Peer(sock, counters)
     peer.role, peer.index = role, index
-    peer.send_json({"type": "hello", "role": role, "index": index})
+    # the clock echo lets the driver estimate this process's perf_counter
+    # offset, so merged trace timelines are causally ordered
+    peer.send_json({"type": "hello", "role": role, "index": index,
+                    "clock": time.perf_counter()})
 
     print(f"worker {role}:{index} building engine", flush=True)
     t0 = time.perf_counter()
@@ -274,6 +337,13 @@ def main(argv) -> int:
                       incarnation=incarnation)
     else:
         _decode_loop(eng, peer, inbox, counters, heartbeat_s=hb)
+    if tcfg and tcfg.get("dir"):
+        try:
+            get_tracer().dump(
+                trace_dump_path(tcfg["dir"], f"{role}:{index}"))
+        except OSError as e:
+            print(f"worker {role}:{index} trace dump failed: {e}",
+                  file=sys.stderr, flush=True)
     print(f"worker {role}:{index} exiting", flush=True)
     peer.close()
     return 0

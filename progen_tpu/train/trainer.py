@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import time
 from typing import Any, Sequence
 
 import jax
@@ -38,6 +39,8 @@ from progen_tpu.models import ProGen, ProGenConfig
 from progen_tpu.observe import (
     ThroughputMeter,
     Tracker,
+    get_registry,
+    get_tracer,
     mfu,
     model_flops_per_token,
     peak_flops_per_chip,
@@ -280,6 +283,11 @@ class Trainer:
         # only when configured.  The recorder outlives run() attempts so
         # a post-retry dump still shows the pre-failure history.
         self._recorder = FlightRecorder(cfg.flight_recorder_n)
+        # span ring shares the process tracer (enabled via
+        # configure_tracing by the entry point); every trainer span also
+        # lands in the flight recorder so a watchdog trip shows the
+        # loop's recent phases even when tracing is off
+        self._tracer = get_tracer()
         self._watchdog: Watchdog | None = None
         if jax.process_count() == 1:
             import signal
@@ -291,6 +299,14 @@ class Trainer:
 
     def _request_preempt_checkpoint(self, signum=None, frame=None) -> None:
         self._preempt_requested = True
+
+    def _note_phase(self, name: str, t0: float, **fields: Any) -> None:
+        """One loop phase -> a trace span AND a flight-recorder event, so
+        a watchdog trip shows the recent phase history whether or not the
+        process is tracing (the recorder is always on)."""
+        dur = time.perf_counter() - t0
+        self._tracer.add(name, t0, dur, **fields)
+        self._recorder.record(name, dur_s=round(dur, 6), **fields)
 
     def _to_device(self, np_batch) -> jax.Array:
         """Host batch -> device array for the jitted step.
@@ -655,12 +671,17 @@ class Trainer:
                         if watchdog is not None and epoch == 1 and i == 0
                         else contextlib.nullcontext()
                     )
+                    t0 = time.perf_counter()
                     with grace:
                         for _ in range(cfg.grad_accum_every):
                             batch = (next(train_it) if prefetched
                                      else self._to_device(next(train_it)))
                             state, metrics = self.fns.train_step(state, batch)
                     global_step += 1
+                    # dispatch time only (the step runs async on device);
+                    # a long span here means input starvation or a compile
+                    self._note_phase("train.step_dispatch", t0,
+                                     step=global_step)
                     # monotonic, never wrapped: the checkpointed cursor must
                     # identify the position in the multi-epoch STREAM
                     seq_cursor = seq_cursor + effective_batch
@@ -676,6 +697,7 @@ class Trainer:
                         # is executed — the only trustworthy sync point, so
                         # the meter ticks HERE with the tokens since the
                         # last sync (one device_get, not one per metric)
+                        t0 = time.perf_counter()
                         host_metrics = jax.device_get(metrics)  # graftcheck: disable=host-sync
                         last_loss = float(host_metrics["loss"])
                         self.meter.tick(pending_tokens)
@@ -697,6 +719,10 @@ class Trainer:
                                 log["mfu"] = util
                         self.tracker.log(log, global_step)
                         self._recorder.record("step", step=global_step, **log)
+                        # log span covers the device_get sync + metric
+                        # assembly — the loop's only blocking point
+                        self._note_phase("train.log", t0, step=global_step)
+                        self.meter.publish(get_registry())
                         if process_index == 0:
                             print(f"step {global_step} loss: {last_loss:.4f}")
 
@@ -713,14 +739,20 @@ class Trainer:
 
                     hooks_ran = False
                     if global_step % cfg.checkpoint_every == 0:
+                        t0 = time.perf_counter()
                         self._checkpoint(state, seq_cursor)
+                        self._note_phase("train.checkpoint", t0,
+                                         step=global_step)
                         hooks_ran = True
 
                     if global_step % cfg.validate_every == 0:
+                        t0 = time.perf_counter()
                         vbatch = self._to_device(next(valid_it))
                         vmetrics = self.fns.eval_step(state, vbatch)
                         vloss = float(jax.device_get(vmetrics["loss"]))  # graftcheck: disable=host-sync
                         self.tracker.log({"valid_loss": vloss}, global_step)
+                        self._note_phase("train.validate", t0,
+                                         step=global_step, loss=vloss)
                         if process_index == 0:
                             print(f"valid_loss: {vloss:.4f}")
                         hooks_ran = True
@@ -816,12 +848,15 @@ class Trainer:
                         else contextlib.nullcontext()
                     )
                     compiled_ks.add(k)
+                    t0 = time.perf_counter()
                     with grace:
                         for _ in range(span // k):
                             state, metrics = self.fns.train_multi_step(
                                 state, stager.get(k))
                     done += span
                     global_step += span
+                    self._note_phase("train.step_dispatch", t0,
+                                     step=global_step, span=span)
                     seq_cursor = seq_cursor + effective_batch * span
                     pending_tokens += effective_batch * seq_len * span
                     pending_steps += span
@@ -835,6 +870,7 @@ class Trainer:
                         # ONE batched transfer fetches the whole span's
                         # K-stacked metrics — the sync point the meter
                         # ticks at, now rating K steps per sync
+                        t0 = time.perf_counter()
                         host_metrics = jax.device_get(metrics)  # graftcheck: disable=host-sync
                         last_loss = float(host_metrics["loss"][-1, -1])
                         self.meter.tick(pending_tokens, steps=pending_steps)
@@ -860,6 +896,10 @@ class Trainer:
                             log["steps_per_sec"] = sps
                         self.tracker.log(log, global_step)
                         self._recorder.record("step", step=global_step, **log)
+                        # log span covers the device_get sync + metric
+                        # assembly — the loop's only blocking point
+                        self._note_phase("train.log", t0, step=global_step)
+                        self.meter.publish(get_registry())
                         if process_index == 0:
                             print(f"step {global_step} loss: {last_loss:.4f}")
 
@@ -874,14 +914,20 @@ class Trainer:
 
                     hooks_ran = False
                     if global_step % cfg.checkpoint_every == 0:
+                        t0 = time.perf_counter()
                         self._checkpoint(state, seq_cursor)
+                        self._note_phase("train.checkpoint", t0,
+                                         step=global_step)
                         hooks_ran = True
 
                     if global_step % cfg.validate_every == 0:
+                        t0 = time.perf_counter()
                         vbatch = self._to_device(next(valid_it))
                         vmetrics = self.fns.eval_step(state, vbatch)
                         vloss = float(jax.device_get(vmetrics["loss"]))  # graftcheck: disable=host-sync
                         self.tracker.log({"valid_loss": vloss}, global_step)
+                        self._note_phase("train.validate", t0,
+                                         step=global_step, loss=vloss)
                         if process_index == 0:
                             print(f"valid_loss: {vloss:.4f}")
                         hooks_ran = True

@@ -88,6 +88,16 @@ honor_env_platforms()
               help="engine: seconds without a completed serve step before "
                    "the watchdog dumps all-thread stacks to CWD and exits "
                    "nonzero (unset = off); compiles are exempt")
+@click.option("--trace", is_flag=True,
+              help="record request spans in every serving process and "
+                   "merge them into one Perfetto trace.json under "
+                   "--trace_out (docs/OBSERVABILITY.md)")
+@click.option("--trace_out", default="trace_out", metavar="DIR",
+              help="directory for per-process trace dumps and the merged "
+                   "trace.json (with --trace)")
+@click.option("--xprof_dir", default=None, metavar="DIR",
+              help="record an xprof/TensorBoard profile of the decode "
+                   "into this directory (view with tensorboard)")
 @click.option("--compile_cache", default=None, metavar="DIR",
               help="JAX persistent compilation cache directory ('0' "
                    "disables); overrides PROGEN_COMPILE_CACHE, default "
@@ -96,7 +106,7 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
          seq_len, mesh_spec, strategies, serve, slots, chunk, paged,
          page_size, serve_attempts, snapshot_path, aot_warmup,
          spec, spec_k, disagg, serve_procs, prefill_procs, replicas,
-         watchdog_timeout, compile_cache):
+         watchdog_timeout, trace, trace_out, xprof_dir, compile_cache):
     import os
 
     import jax
@@ -115,6 +125,17 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
     from progen_tpu.data import decode_tokens, encode_tokens
     from progen_tpu.decode import make_sampler
     from progen_tpu.models import ProGen, ProGenConfig
+    from progen_tpu.observe import profile_trace
+    from progen_tpu.observe.trace import (
+        configure_tracing,
+        get_tracer,
+        merge_trace_dir,
+        trace_dump_path,
+    )
+
+    if trace:
+        os.makedirs(trace_out, exist_ok=True)
+        configure_tracing(enabled=True, process="driver")
 
     store = CheckpointStore(checkpoint_path)
     meta = store.restore_meta()
@@ -183,15 +204,22 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                 checkpoint_path=os.path.abspath(checkpoint_path),
                 engine=dict(num_slots=slots, chunk_size=chunk,
                             max_len=seq_len, paged=paged,
-                            page_size=page_size, spec=spec, spec_k=spec_k))
+                            page_size=page_size, spec=spec, spec_k=spec_k),
+                trace=({"dir": os.path.abspath(trace_out)}
+                       if trace else None))
             cluster = ServeCluster(wspec, prefill_procs=prefill_procs,
                                    replicas=replicas)
             try:
-                for r in requests:
-                    cluster.submit(r)
-                completions = cluster.drain()
+                with profile_trace(xprof_dir):
+                    for r in requests:
+                        cluster.submit(r)
+                    completions = cluster.drain()
             finally:
                 cluster.shutdown()
+            if trace:
+                merged = merge_trace_dir(trace_out)
+                if merged:
+                    print(f"trace: {merged}")
             for comp in sorted(completions, key=lambda c: c.uid):
                 print(f"\n {primes[comp.uid]} \n", "*" * 40,
                       f"[{comp.finish_reason}, {len(comp.tokens)} tokens, "
@@ -219,12 +247,18 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
             return eng
 
         try:
-            completions = run_with_restarts(
-                engine_factory, requests, attempts=serve_attempts,
-                snapshot_path=snapshot_path)
+            with profile_trace(xprof_dir):
+                completions = run_with_restarts(
+                    engine_factory, requests, attempts=serve_attempts,
+                    snapshot_path=snapshot_path)
         finally:
             if watchdog is not None:
                 watchdog.stop()
+        if trace:
+            get_tracer().dump(trace_dump_path(trace_out, "driver"))
+            merged = merge_trace_dir(trace_out)
+            if merged:
+                print(f"trace: {merged}")
         for comp in sorted(completions, key=lambda c: c.uid):
             print(f"\n {primes[comp.uid]} \n", "*" * 40,
                   f"[{comp.finish_reason}, {len(comp.tokens)} tokens, "
@@ -241,14 +275,17 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                            strategies=strategy_list, params_shardings=param_sh)
     keys = KeySeq(seed)
     # add_bos handles empty primes too (a lone BOS column primes the model)
-    if batch.shape[1] == 0:
-        batch = jnp.zeros((num_samples, 1), jnp.int32)
-        sampled = sampler({"params": params}, next(keys), batch, length=seq_len,
-                          top_k=top_k, temperature=temperature)
-        prime_length = 1
-    else:
-        sampled = sampler({"params": params}, next(keys), batch, length=seq_len,
-                          top_k=top_k, add_bos=True, temperature=temperature)
+    with profile_trace(xprof_dir):
+        if batch.shape[1] == 0:
+            batch = jnp.zeros((num_samples, 1), jnp.int32)
+            sampled = sampler({"params": params}, next(keys), batch,
+                              length=seq_len, top_k=top_k,
+                              temperature=temperature)
+            prime_length = 1
+        else:
+            sampled = sampler({"params": params}, next(keys), batch,
+                              length=seq_len, top_k=top_k, add_bos=True,
+                              temperature=temperature)
 
     for row in np.asarray(sampled):
         print("\n", prime, "\n", "*" * 40, "\n",

@@ -50,6 +50,24 @@ def test_meter_window_slides():
     assert len(m._intervals) == 2  # only the last `window` intervals kept
 
 
+def test_meter_snapshot_and_publish():
+    from progen_tpu.observe.metrics import MetricsRegistry
+
+    m = ThroughputMeter(window=2)
+    m.tick(0)
+    time.sleep(0.01)
+    m.tick(500, steps=2)
+    snap = m.snapshot()
+    assert snap["window"] == 2 and snap["intervals"] == 1
+    assert snap["tokens_per_sec"] == pytest.approx(m.tokens_per_sec)
+    assert snap["steps_per_sec"] == pytest.approx(m.steps_per_sec)
+    reg = MetricsRegistry()
+    m.publish(reg)
+    assert reg.gauge("meter.tokens_per_sec").value == pytest.approx(
+        snap["tokens_per_sec"])
+    assert reg.gauge("meter.window").value == 2
+
+
 def test_model_flops_per_token_dominated_by_6n():
     cfg = ProGenConfig(dim=1024, depth=12, heads=8, dim_head=128,
                        window_size=256, seq_len=1024)

@@ -326,6 +326,11 @@ def _bare_cluster(prefill=1, replicas=1, max_restarts=0):
     c._peers, c._procs, c._incarnations = {}, {}, {}
     c._handled_dead, c._respawning = set(), set()
     c._parked_uids, c._worker_stats, c._hb = [], {}, {}
+    c._stats_age, c._clock_offsets = {}, {}
+    from progen_tpu.observe import metrics as _metrics
+    from progen_tpu.observe import trace as _trace
+    c._tracer = _trace.get_tracer()
+    c._lat = _metrics.get_registry().histogram("cluster.latency_s")
     c._shutting_down = False
     c._spawn = lambda role, idx: None    # supervision grants don't fork
     for i in range(prefill):

@@ -57,6 +57,19 @@ JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
     --max-new 6 --prime-min 4 --prime-max 12 \
     --serve-procs --verify
 
+echo "== trace smoke =="
+# 2-process cluster with tracing on: every process dumps its span ring,
+# the driver merges them with clock-offset correction into ONE
+# Perfetto-loadable trace.json, and traceview must find + summarize the
+# spans (exit 0).  docs/OBSERVABILITY.md has the design.
+TRACE_DIR="$(mktemp -d)"
+trap 'rm -rf "$TRACE_DIR"' EXIT
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 4 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --serve-procs --trace --trace-out "$TRACE_DIR"
+python tools/traceview.py --summarize "$TRACE_DIR/trace.json"
+
 echo "== superstep quick-bench smoke =="
 # tiny-shape K-sweep on CPU: proves the fused dispatch path runs end to
 # end and emits parseable JSON (full sweep: benchmarks/superstep.md)
