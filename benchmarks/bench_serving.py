@@ -160,6 +160,18 @@ def main() -> None:
                     help="fraction of requests with near-max_len primes "
                          "(mixed long-prefill load); the rest draw short "
                          "primes from [prime-min, prime-max/4]")
+    ap.add_argument("--scenario-mix", default=None,
+                    help="weighted workload mix, e.g. 'generate=0.5,"
+                         "infill=0.2,embed=0.2,lora=0.1': ONE Poisson "
+                         "stream mixing all four first-class workloads "
+                         "through one engine; the record carries "
+                         "per-workload p50/p95 latency.  Not combinable "
+                         "with --spec/--disagg/--serve-procs/--chaos")
+    ap.add_argument("--lora-tenants", type=int, default=4,
+                    help="adapter bank size T for the lora workload "
+                         "(tenant 0 is the zero-adapter base; lora "
+                         "requests cycle tenants 1..T-1)")
+    ap.add_argument("--lora-rank", type=int, default=8)
     ap.add_argument("--chaos", action="store_true",
                     help="arm the fault injector with --faults and record "
                          "a serving_chaos line (goodput, within-SLO "
@@ -235,6 +247,11 @@ def main() -> None:
     toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
     params = unbox(jax.jit(model.init)(jax.random.key(0), toks))
 
+    mix = _parse_mix(args.scenario_mix) if args.scenario_mix else None
+    if mix and (args.spec or args.disagg or args.serve_procs or args.chaos):
+        raise SystemExit("--scenario-mix drives one in-process engine; "
+                         "drop --spec/--disagg/--serve-procs/--chaos")
+
     rng = np.random.default_rng(args.seed)
     pmax = min(args.prime_max, cfg.seq_len - args.max_new - 1)
     pmin = min(args.prime_min, pmax)
@@ -254,13 +271,56 @@ def main() -> None:
                               int(rng.integers(pmin, pmax + 1))).tolist()
                  for _ in range(args.requests)]
 
+    # per-request workload assignment (and infill scaffolds) are fixed up
+    # front too, same reason: --verify reruns replay the identical mix
+    workloads = ["generate"] * args.requests
+    scaffolds: dict = {}
+    if mix:
+        from progen_tpu.workloads import ScaffoldSpec
+
+        live = sorted(w for w in mix if mix[w] > 0)
+        workloads = list(rng.choice(live, size=args.requests,
+                                    p=[mix[w] for w in live]))
+        # guarantee every requested workload appears at least once
+        for i, w in enumerate(live[:args.requests]):
+            workloads[i] = w
+        for uid, w in enumerate(workloads):
+            if w != "infill":
+                continue
+            srng = np.random.default_rng(args.seed + 31 * uid)
+            tmpl: list = list(specs[uid])
+            for g in range(args.max_new):
+                r = srng.random()
+                if g > 0 and r < 0.25:
+                    # interior frozen scaffold position (one-hot row)
+                    tmpl.append(int(srng.integers(1, cfg.num_tokens)))
+                elif r < 0.625:
+                    k = min(8, cfg.num_tokens - 1)
+                    allowed = srng.choice(np.arange(1, cfg.num_tokens),
+                                          size=k, replace=False)
+                    tmpl.append(tuple(int(a) for a in allowed))
+                else:
+                    tmpl.append(None)
+            scaffolds[uid] = ScaffoldSpec(template=tmpl,
+                                          vocab=cfg.num_tokens)
+
     def make_request(uid: int, submit_time: float,
                      ttl: float | None = None) -> Request:
-        return Request(
-            uid=uid, tokens=specs[uid], max_new_tokens=args.max_new,
-            top_k=25, temperature=1.0, seed=args.seed + uid,
-            submit_time=submit_time, ttl=ttl,
-        )
+        common = dict(uid=uid, top_k=25, temperature=1.0,
+                      seed=args.seed + uid, submit_time=submit_time,
+                      ttl=ttl)
+        w = workloads[uid]
+        if w == "infill":
+            return Request(workload="infill",
+                           **scaffolds[uid].request_kwargs(), **common)
+        if w == "embed":
+            return Request(tokens=specs[uid], max_new_tokens=args.max_new,
+                           workload="embed", **common)
+        tenant = 0
+        if w == "lora":
+            tenant = 1 + uid % max(1, args.lora_tenants - 1)
+        return Request(tokens=specs[uid], max_new_tokens=args.max_new,
+                       tenant=tenant, workload=w, **common)
 
     max_len = args.max_len or min(cfg.seq_len, pmax + args.max_new + 1)
     num_pages = args.num_pages
@@ -290,13 +350,23 @@ def main() -> None:
         handoff_depth=args.handoff_depth,
     )
 
+    lora_kwargs: dict = {}
+    if mix and mix.get("lora", 0) > 0:
+        from progen_tpu.workloads.lora import random_lora_bank
+
+        lora_kwargs = dict(lora_bank=random_lora_bank(
+            cfg, args.lora_tenants, args.lora_rank, seed=args.seed + 7))
+
     def mk_engine(*, robust: bool, use_spec: bool | None = None,
-                  use_disagg: bool | None = None) -> ServingEngine:
+                  use_disagg: bool | None = None,
+                  use_lora: bool = True) -> ServingEngine:
         kw = dict(paged_kwargs)
         if use_spec if use_spec is not None else args.spec:
             kw.update(spec_kwargs)
         if use_disagg if use_disagg is not None else args.disagg:
             kw.update(disagg_kwargs)
+        if use_lora:
+            kw.update(lora_kwargs)
         if robust:
             kw.update(max_queue=args.max_queue,
                       shed_policy=args.shed_policy)
@@ -307,9 +377,11 @@ def main() -> None:
     # warmup: compile the admission + chunk programs off the clock — AOT
     # over the whole (bucket, chunk) grid, or two sacrificial requests
     # (drawn from a SEPARATE rng so the measured specs stay fixed)
+    warm_embed = bool(mix and mix.get("embed", 0) > 0)
+
     def warm(eng: ServingEngine) -> None:
         if args.aot_warmup:
-            stats = eng.aot_warmup(max_prime=pmax)
+            stats = eng.aot_warmup(max_prime=pmax, embed=warm_embed)
             print(f"aot warmup: {stats['programs']} programs in "
                   f"{stats['seconds']:.1f}s", file=sys.stderr)
             return
@@ -320,6 +392,11 @@ def main() -> None:
                 tokens=wrng.integers(1, cfg.num_tokens, pmax).tolist(),
                 max_new_tokens=args.max_new, top_k=25, temperature=1.0,
                 seed=args.seed, submit_time=time.perf_counter()))
+        if warm_embed:
+            eng.submit_embed(Request(
+                uid=10_000_100, tokens=wrng.integers(
+                    1, cfg.num_tokens, pmax).tolist(),
+                submit_time=time.perf_counter()))
         eng.run_until_idle()
         eng.completions.clear()
 
@@ -335,8 +412,11 @@ def main() -> None:
         while len(served) < args.requests:
             now = time.perf_counter() - t0
             while nxt < args.requests and arrivals[nxt] <= now:
-                eng.submit(make_request(nxt, t0 + arrivals[nxt],
-                                        ttl=args.ttl))
+                req = make_request(nxt, t0 + arrivals[nxt], ttl=args.ttl)
+                if getattr(req, "workload", "generate") == "embed":
+                    eng.submit_embed(req)
+                else:
+                    eng.submit(req)
                 nxt += 1
             if not eng.has_work:
                 if nxt >= args.requests:
@@ -378,7 +458,10 @@ def main() -> None:
 
     plan = serving_plan(cfg, num_slots=args.slots, max_len=max_len,
                         paged=args.paged, page_size=args.page_size,
-                        num_pages=num_pages)
+                        num_pages=num_pages,
+                        lora_tenants=(args.lora_tenants if lora_kwargs
+                                      else 0),
+                        lora_rank=args.lora_rank)
     record = stamp_record({
         "metric": "serving_chaos" if args.chaos else "serving",
         "config": args.config,
@@ -404,6 +487,30 @@ def main() -> None:
     })
     if args.long_frac > 0:
         record["long_frac"] = args.long_frac
+    if mix:
+        # per-workload latency through the SAME shared percentile helper
+        # (and registry histograms bench.<workload>_latency_s)
+        by_workload = {}
+        for w in sorted(w for w in mix if mix[w] > 0):
+            wc = [c for c in ok if workloads[c.uid] == w]
+            lat_w = sorted(c.latency for c in wc) or [0.0]
+            w50, w95 = latency_percentiles(
+                lat_w, name=f"bench.{w}_latency_s")
+            by_workload[w] = {
+                "requests": len(wc),
+                "generated_tokens": int(sum(len(c.tokens) for c in wc)),
+                "p50_latency_s": round(w50, 3),
+                "p95_latency_s": round(w95, 3),
+            }
+        record["metric"] = "serving_mix"
+        record["scenario_mix"] = {k: round(v, 3) for k, v in mix.items()}
+        record["workloads"] = by_workload
+        record["lmask_hbm_bytes"] = (plan.lmask_bytes_per_slot
+                                     * args.slots)
+        if lora_kwargs:
+            record["lora_tenants"] = args.lora_tenants
+            record["lora_rank"] = args.lora_rank
+            record["adapter_hbm_bytes"] = plan.adapter_bytes
     if args.spec:
         sc = engine.spec_counters()
         record.update({
@@ -461,7 +568,11 @@ def main() -> None:
         })
 
     if args.verify:
-        _verify(mk_engine, make_request, done, args)
+        if mix:
+            _verify_mix(mk_engine, make_request, done, workloads,
+                        scaffolds, args)
+        else:
+            _verify(mk_engine, make_request, done, args)
         record["verified"] = True
 
     if args.trace:
@@ -638,6 +749,106 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
     if args.out:
         with open(args.out, "a") as f:
             f.write(line + "\n")
+
+
+def _parse_mix(s: str) -> dict[str, float]:
+    """``'generate=0.5,infill=0.2,...'`` -> normalized weight dict."""
+    from progen_tpu.workloads import WORKLOADS
+
+    mix: dict[str, float] = {}
+    for part in s.split(","):
+        name, eq, w = part.partition("=")
+        name = name.strip()
+        if name not in WORKLOADS or not eq:
+            raise SystemExit(
+                f"bad --scenario-mix entry {part!r}; entries are "
+                f"<workload>=<weight> with workload in {WORKLOADS}")
+        mix[name] = float(w)
+    if any(v < 0 for v in mix.values()) or sum(mix.values()) <= 0:
+        raise SystemExit("--scenario-mix weights must be >= 0 and sum > 0")
+    total = sum(mix.values())
+    return {k: v / total for k, v in mix.items()}
+
+
+def _verify_mix(mk_engine, make_request, done, workloads, scaffolds,
+                args) -> None:
+    """Scenario-mix correctness gate, asserted on the measured run:
+
+    * rerun identity — a fresh engine serving the same request set
+      reproduces every completion (tokens for generate/infill/lora,
+      bit-equal vectors for embed);
+    * constraint enforcement — every infill completion's generated tokens
+      satisfy the scaffold's per-position allowed sets;
+    * zero-adapter identity — the mix's tenant-0 requests (generate +
+      infill + embed) are bit-identical on an engine built WITHOUT the
+      adapter bank (serving LoRA tenants cannot perturb the base path);
+    * snapshot replay — snapshot mid-run on a third engine, restore on a
+      fresh one, and the merged completions match the rerun.
+    """
+    import time
+
+    def submit_all(eng) -> None:
+        for uid in range(args.requests):
+            req = make_request(uid, time.perf_counter())
+            if getattr(req, "workload", "generate") == "embed":
+                eng.submit_embed(req)
+            else:
+                eng.submit(req)
+
+    def payload(c):
+        if c.embedding is not None:
+            return ("embed", c.embedding.tobytes())
+        return ("tokens", tuple(int(t) for t in c.tokens))
+
+    clean_eng = mk_engine(robust=False)
+    submit_all(clean_eng)
+    clean = {c.uid: payload(c) for c in clean_eng.run_until_idle()}
+
+    measured = {c.uid: payload(c) for c in done if c.ok}
+    mismatched = [u for u, p in measured.items() if clean[u] != p]
+    assert not mismatched, (
+        f"scenario-mix rerun diverged for uids {mismatched}")
+
+    for uid, spec in scaffolds.items():
+        if uid not in measured or measured[uid][0] != "tokens":
+            continue
+        gen = measured[uid][1]
+        mask = spec.logit_mask()
+        bad = [g for g, t in enumerate(gen[:mask.shape[0]])
+               if not mask[g, t]]
+        assert not bad, (
+            f"infill uid {uid} emitted masked tokens at positions {bad}")
+
+    base_uids = [u for u in range(args.requests)
+                 if workloads[u] != "lora"]
+    if base_uids:
+        plain = mk_engine(robust=False, use_lora=False)
+        for uid in base_uids:
+            req = make_request(uid, time.perf_counter())
+            if getattr(req, "workload", "generate") == "embed":
+                plain.submit_embed(req)
+            else:
+                plain.submit(req)
+        base = {c.uid: payload(c) for c in plain.run_until_idle()}
+        drifted = [u for u in base_uids
+                   if u in measured and base[u] != measured[u]]
+        assert not drifted, (
+            f"tenant-0 requests diverged between the adapter-bank engine "
+            f"and the bankless engine for uids {drifted}")
+
+    snap_eng = mk_engine(robust=False)
+    submit_all(snap_eng)
+    for _ in range(2):
+        snap_eng.step()
+    snap = snap_eng.snapshot()
+    pre = {c.uid: payload(c) for c in snap_eng.completions}
+    replay_eng = mk_engine(robust=False)
+    replay_eng.restore(snap)
+    post = {c.uid: payload(c) for c in replay_eng.run_until_idle()}
+    assert {**pre, **post} == clean, (
+        "scenario-mix snapshot -> restore -> replay diverged")
+    print("verify: scenario-mix rerun identity, constraint enforcement, "
+          "tenant-0 identity and snapshot replay OK", file=sys.stderr)
 
 
 def _verify(mk_engine, make_request, done, args) -> None:

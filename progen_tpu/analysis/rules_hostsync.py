@@ -33,6 +33,10 @@ class Zone:
     # self attributes known to hold host-side containers (queues, configs,
     # request bookkeeping) — reads/method calls on them are not syncs
     host_attrs: frozenset = frozenset()
+    # parameter names that carry host-side payloads by contract (client
+    # Request objects, JSON-safe snapshots, numpy masks) — casts and
+    # asarray over them validate host data, they never drain the queue
+    host_params: frozenset = frozenset()
 
 
 # the hot zones for this codebase
@@ -52,7 +56,9 @@ HOT_ZONES: tuple[Zone, ...] = (
         r"|_dispatch_chunk|_fail_inflight|_activate_xla_fallback"
         r"|_drain_pending|robustness_counters|_prefill_round"
         r"|_admit_from_handoff|_prefill_worker_call|_merge_call"
-        r"|admit_handle|run_prefill_round|drain_sheds|_note_stage)$",
+        r"|admit_handle|run_prefill_round|drain_sheds|_note_stage"
+        r"|submit_embed|_embed_round|run_embed_round|embed_pending"
+        r"|_build_lmask)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
@@ -64,7 +70,11 @@ HOT_ZONES: tuple[Zone, ...] = (
                    "paged_impl", "_watchdog", "_handoff", "disagg",
                    "spec", "spec_k", "prefill_batch", "_max_advance",
                    "_spec_rounds", "remote_prefill", "stage_seconds",
-                   "_tracer", "_stage_hist"}),
+                   "_tracer", "_stage_hist", "_embed_queue", "lora"}),
+        # requests, admission rows and snapshots are host payloads by API
+        # contract: numpy masks, python ints, JSON-safe dicts — never
+        # device arrays
+        frozenset({"request", "rows", "snap"}),
     ),
     # the page pool is pure host bookkeeping between dispatches: nothing
     # in it may touch a device value, so every sync call is a finding
@@ -144,9 +154,15 @@ class _HostSafe:
         self,
         fn: ast.FunctionDef | ast.AsyncFunctionDef,
         host_attrs: frozenset = frozenset(),
+        host_params: frozenset = frozenset(),
     ):
         self.names: set[str] = set()
         self.host_attrs = host_attrs
+        # zone-declared host payload parameters seed the fixpoint
+        for arg in (*fn.args.args, *fn.args.posonlyargs,
+                    *fn.args.kwonlyargs):
+            if arg.arg in host_params:
+                self.names.add(arg.arg)
         # fixpoint over simple assignments: device_get results and pure
         # arithmetic/numpy over host-safe names stay host-safe
         for _ in range(3):
@@ -228,7 +244,8 @@ def check(module: ParsedModule, ctx: RepoContext):
         zone = _zone_for(module.path, qual)
         if zone is None:
             continue
-        safe = _HostSafe(fn, host_attrs=zone.host_attrs)
+        safe = _HostSafe(fn, host_attrs=zone.host_attrs,
+                         host_params=zone.host_params)
         own_stmts = _own_nodes(fn, quals)
         for node in own_stmts:
             if not isinstance(node, ast.Call):

@@ -110,6 +110,7 @@ from progen_tpu.decode.prefill import (
     _constrain_caches,
     harvest_caches,
     harvest_gate_pages,
+    make_embedder,
     pad_prime_length,
     prime_buckets,
     scatter_gate_rows,
@@ -158,6 +159,13 @@ class Request:
     (``deadline`` wins when both are set).  Past it the request is shed
     with a ``SHED_DEADLINE`` completion — queued requests before they
     cost a prefill, in-flight ones mid-decode with their partial tokens.
+
+    Workload knobs: ``logit_mask`` is an optional ``(G, V)`` bool array
+    (``G ≤ max_new_tokens``) constraining generated position ``g`` to
+    its true entries (``workloads/infill.ScaffoldSpec`` builds these;
+    positions past ``G`` are unconstrained); ``tenant`` selects a row of
+    the engine's LoRA adapter bank (0 = base model; nonzero requires the
+    engine to hold a bank).
     """
 
     uid: Any
@@ -170,6 +178,12 @@ class Request:
     ttl: float | None = None
     on_complete: Callable[["Completion"], None] | None = None
     submit_time: float = dataclasses.field(default_factory=time.perf_counter)
+    logit_mask: Any = None
+    tenant: int = 0
+    # request class for routing ("generate" | "embed") — the cluster
+    # frontend sets "embed" via submit_embed(); in-process callers use
+    # the engine's submit()/submit_embed() methods directly
+    workload: str = "generate"
 
 
 @dataclasses.dataclass
@@ -187,10 +201,11 @@ class Completion:
     uid: Any
     prime: np.ndarray
     tokens: np.ndarray
-    finish_reason: str  # "eos" | "length" | shed status
+    finish_reason: str  # "eos" | "length" | "embed" | shed status
     submit_time: float
     finish_time: float
     status: str = STATUS_OK
+    embedding: np.ndarray | None = None  # (D,) f32 for embed requests
 
     @property
     def latency(self) -> float:
@@ -270,7 +285,8 @@ class ServingEngine:
                  spec: bool = False, draft_config: ProGenConfig | None = None,
                  draft_params=None, spec_k: int = 4,
                  disagg: bool = False, prefill_batch: int | None = None,
-                 handoff_depth: int = 2, remote_prefill: bool = False):
+                 handoff_depth: int = 2, remote_prefill: bool = False,
+                 lora_bank=None):
         self.config = config
         self.policy = policy or make_policy()
         self.num_slots = num_slots
@@ -299,7 +315,7 @@ class ServingEngine:
         # device calls) — multi-process bench records prove prefill wall
         # LEAVES the decode process (its prefill_s stays 0.0)
         self.stage_seconds = {"prefill_s": 0.0, "merge_s": 0.0,
-                              "decode_chunk_s": 0.0}
+                              "decode_chunk_s": 0.0, "embed_s": 0.0}
         # the same deltas feed the process tracer (no-op unless enabled)
         # and the shared metrics registry's per-stage latency histograms
         self._tracer = _obs_trace.get_tracer()
@@ -308,6 +324,7 @@ class ServingEngine:
             "prefill_s": registry.histogram("engine.prefill_s"),
             "merge_s": registry.histogram("engine.merge_s"),
             "decode_chunk_s": registry.histogram("engine.decode_chunk_s"),
+            "embed_s": registry.histogram("engine.embed_s"),
         }
 
         if params_shardings is not None:
@@ -315,6 +332,22 @@ class ServingEngine:
 
         self.spec = spec
         self.disagg = disagg
+        self.lora = lora_bank is not None
+        if self.lora:
+            # composition bounds: the adapter gather composes with dense
+            # and paged decode; the spec draft/commit scans and the
+            # disagg handle transport do not carry tenant state (yet)
+            if spec:
+                raise ValueError("lora_bank does not compose with spec=True")
+            if disagg:
+                raise ValueError("lora_bank does not compose with "
+                                 "disagg=True")
+            from progen_tpu.workloads.lora import validate_lora_bank
+
+            self.num_tenants = validate_lora_bank(config, lora_bank)
+            lora_bank = jax.tree.map(jnp.asarray, lora_bank)
+        else:
+            self.num_tenants = 1
         if spec:
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
@@ -343,6 +376,11 @@ class ServingEngine:
             self._spec_emitted = jnp.zeros((), jnp.int32)
             self._spec_verify_rounds = jnp.zeros((), jnp.int32)
             self._params = {"target": params, "draft": draft_params}
+        elif self.lora:
+            self._max_advance = chunk_size
+            # the bank rides the params pytree so every AOT program takes
+            # it as a real argument (hot-swappable without recompiles)
+            self._params = {"base": params, "adapters": lora_bank}
         else:
             self._max_advance = chunk_size
             self._params = params
@@ -408,6 +446,13 @@ class ServingEngine:
             self._merge = jax.jit(self._merge_impl, donate_argnums=(1,))
         else:
             self._handoff = None
+        # embeddings endpoint: a separate request class served by a
+        # prefill-shaped program — consumes no decode slots, batches per
+        # prime bucket, AOT-warmable like admission
+        self._embed_queue: deque[Request] = deque()
+        self.embed_batch = num_slots
+        self._embedder = make_embedder(config, self.policy, mesh=mesh,
+                                       strategies=self.strategies)
         self.state = self._init_state()
 
     # ---------------------------------------------------------------- state
@@ -436,7 +481,13 @@ class ServingEngine:
             "keys": jax.random.key_data(keys),     # raw uint32 key data
             "top_k": jnp.zeros((s,), jnp.int32),   # 0 = disabled
             "temp": jnp.ones((s,), jnp.float32),
+            # per-slot per-position logit mask, indexed by WRITE position;
+            # all-true rows are bit-identical to no masking at all, so the
+            # plain generate path pays only the (S, L, V)-bool gather
+            "lmask": jnp.ones((s, L, self.config.num_tokens), bool),
         }
+        if self.lora:
+            state["tenant"] = jnp.zeros((s,), jnp.int32)
         if self.spec:
             # the draft's caches stay DENSE per slot even in paged mode:
             # the draft is tiny, paging its rows would buy nothing
@@ -518,10 +569,27 @@ class ServingEngine:
         fn = self._aot.get(("chunk",), self._decode_chunk)
         return fn(self._params, self.state, *args)
 
+    def _embed_call(self, tokens, lengths):
+        """Dispatch the embedding program for this prefill bucket (AOT
+        executable when warmed).  Embeddings always run the BASE model —
+        no sampling, no adapters, no slot state."""
+        fn = self._aot.get(("embed", tokens.shape[1]), self._embedder)
+        return fn(self._target_params(self._params), tokens, lengths)
+
     def _target_params(self, params):
         """Under speculative decoding ``self._params`` bundles target and
-        draft weights; plain serving passes the target tree through."""
-        return params["target"] if self.spec else params
+        draft weights; under LoRA it bundles the base tree and the
+        adapter bank; plain serving passes the target tree through."""
+        if self.spec:
+            return params["target"]
+        if self.lora:
+            return params["base"]
+        return params
+
+    def _adapters(self, params):
+        """The stacked adapter bank when serving LoRA, else ``None`` (the
+        model applies no delta and traces exactly as before)."""
+        return params["adapters"] if self.lora else None
 
     def _activate_xla_fallback(self) -> None:
         """Degrade the paged decode step from the Pallas ragged kernel to
@@ -559,11 +627,17 @@ class ServingEngine:
                 tok = jnp.take_along_axis(st["seq"], pos[:, None],
                                           axis=1)[:, 0]
                 logits, caches = self._step_model.apply(
-                    params, tok, pos, st["caches"])
+                    self._target_params(params), tok, pos, st["caches"],
+                    self._adapters(params), st.get("tenant"))
                 kd, sub = split_keys_batched(st["keys"])
-                nxt = gumbel_topk_sample_batched(
-                    sub, logits, st["top_k"], st["temp"]).astype(jnp.int32)
                 writepos = jnp.clip(pos + 1, 0, self.max_len - 1)
+                # the infill mask row for the position this step WRITES;
+                # all-pass rows leave sampling bit-identical
+                mrow = jnp.take_along_axis(
+                    st["lmask"], writepos[:, None, None], axis=1)[:, 0]
+                nxt = gumbel_topk_sample_batched(
+                    sub, logits, st["top_k"], st["temp"],
+                    mask=mrow).astype(jnp.int32)
                 cur = jnp.take_along_axis(st["seq"], writepos[:, None],
                                           axis=1)[:, 0]
                 val = jnp.where(live, nxt, cur)
@@ -583,14 +657,17 @@ class ServingEngine:
         return state
 
     def _admit_impl(self, params, state, tokens, lengths, stops, seeds,
-                    top_k, temp, mask):
+                    top_k, temp, mask, lmask, tenant=None):
         """Prefill ``tokens (S, P_pad)`` in one parallel forward and merge
         rows where ``mask`` into ``state`` (rows outside ``mask`` carry
-        dummy primes and are discarded)."""
+        dummy primes and are discarded).  ``lmask (S, L, V)`` is each
+        row's infill logit mask indexed by write position (all-true for
+        unconstrained requests); ``tenant (S,)`` rides only under LoRA."""
         cfg = self.config
         with self._trace_ctx():
             logits, varz = self._prefill_model.apply(
-                self._target_params(params), tokens, mutable=["cache"])
+                self._target_params(params), tokens,
+                self._adapters(params), tenant, mutable=["cache"])
             caches_new = harvest_caches(cfg, varz["cache"], lengths,
                                         self.policy, self.max_len)
             if self.mesh is not None:
@@ -608,8 +685,13 @@ class ServingEngine:
         )[:, 0].astype(jnp.float32)
         keys = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
         split = jax.vmap(jax.random.split)(keys)
+        # the first generated token writes at position ``lengths`` — its
+        # mask row applies here, not in the decode chunk
+        first_mrow = jnp.take_along_axis(
+            lmask, lengths[:, None, None], axis=1)[:, 0]
         first = gumbel_topk_sample_batched(
-            split[:, 1], last, top_k, temp).astype(jnp.int32)
+            split[:, 1], last, top_k, temp,
+            mask=first_mrow).astype(jnp.int32)
 
         s, L = self.num_slots, self.max_len
         p_pad = tokens.shape[1]
@@ -638,7 +720,10 @@ class ServingEngine:
             "keys": merge(jax.random.key_data(split[:, 0]), state["keys"]),
             "top_k": merge(top_k, state["top_k"]),
             "temp": merge(temp, state["temp"]),
+            "lmask": merge(lmask, state["lmask"]),
         }
+        if self.lora:
+            out["tenant"] = merge(tenant, state["tenant"])
         if self.spec:
             out["draft_caches"] = jax.tree.map(
                 merge, draft_new, state["draft_caches"])
@@ -668,7 +753,8 @@ class ServingEngine:
                 tok = jnp.take_along_axis(st["seq"], pos[:, None],
                                           axis=1)[:, 0]
                 logits, caches = self._paged_step_model.apply(
-                    params, tok, pos, st["caches"], table, live)
+                    self._target_params(params), tok, pos, st["caches"],
+                    table, live, self._adapters(params), st.get("tenant"))
 
                 def mrg(new, old):
                     m = live.reshape((-1,) + (1,) * (old.ndim - 1))
@@ -680,9 +766,12 @@ class ServingEngine:
                     "sgu_pool": caches["sgu_pool"],
                 }
                 kd, sub = split_keys_batched(st["keys"])
-                nxt = gumbel_topk_sample_batched(
-                    sub, logits, st["top_k"], st["temp"]).astype(jnp.int32)
                 writepos = jnp.clip(pos + 1, 0, self.max_len - 1)
+                mrow = jnp.take_along_axis(
+                    st["lmask"], writepos[:, None, None], axis=1)[:, 0]
+                nxt = gumbel_topk_sample_batched(
+                    sub, logits, st["top_k"], st["temp"],
+                    mask=mrow).astype(jnp.int32)
                 cur = jnp.take_along_axis(st["seq"], writepos[:, None],
                                           axis=1)[:, 0]
                 val = jnp.where(live, nxt, cur)
@@ -702,7 +791,8 @@ class ServingEngine:
         return state
 
     def _admit_paged_impl(self, params, state, tokens, lengths, stops,
-                          seeds, top_k, temp, mask, table, wtable):
+                          seeds, top_k, temp, mask, lmask, table, wtable,
+                          tenant=None):
         """Paged twin of ``_admit_impl``: rings/carries harvest and merge
         as in the dense path, but gate rows scatter straight into the
         page pool through the WRITE table (``wtable`` — private pages
@@ -710,7 +800,8 @@ class ServingEngine:
         cfg = self.config
         with self._trace_ctx():
             logits, varz = self._prefill_model.apply(
-                self._target_params(params), tokens, mutable=["cache"])
+                self._target_params(params), tokens,
+                self._adapters(params), tenant, mutable=["cache"])
             caches_new = harvest_caches(cfg, varz["cache"], lengths,
                                         self.policy, self.max_len,
                                         with_sgu=False)
@@ -734,8 +825,11 @@ class ServingEngine:
         )[:, 0].astype(jnp.float32)
         keys = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
         split = jax.vmap(jax.random.split)(keys)
+        first_mrow = jnp.take_along_axis(
+            lmask, lengths[:, None, None], axis=1)[:, 0]
         first = gumbel_topk_sample_batched(
-            split[:, 1], last, top_k, temp).astype(jnp.int32)
+            split[:, 1], last, top_k, temp,
+            mask=first_mrow).astype(jnp.int32)
 
         s, L = self.num_slots, self.max_len
         p_pad = tokens.shape[1]
@@ -766,7 +860,10 @@ class ServingEngine:
             "keys": merge(jax.random.key_data(split[:, 0]), state["keys"]),
             "top_k": merge(top_k, state["top_k"]),
             "temp": merge(temp, state["temp"]),
+            "lmask": merge(lmask, state["lmask"]),
         }
+        if self.lora:
+            out["tenant"] = merge(tenant, state["tenant"])
         if self.spec:
             out["draft_caches"] = jax.tree.map(
                 merge, draft_new, state["draft_caches"])
@@ -857,7 +954,7 @@ class ServingEngine:
     # ------------------------------------------------- disaggregated serving
 
     def _prefill_worker_impl(self, params, tokens, lengths, stops, seeds,
-                             top_k, temp):
+                             top_k, temp, lmask):
         """Prefill stage of disaggregated serving: same math as the admit
         impls but with NO slot state in scope — the product is a handle
         of ``(num_slots, ...)`` slabs the merge program later gathers
@@ -885,8 +982,11 @@ class ServingEngine:
         )[:, 0].astype(jnp.float32)
         keys = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
         split = jax.vmap(jax.random.split)(keys)
+        first_mrow = jnp.take_along_axis(
+            lmask, lengths[:, None, None], axis=1)[:, 0]
         first = gumbel_topk_sample_batched(
-            split[:, 1], last, top_k, temp).astype(jnp.int32)
+            split[:, 1], last, top_k, temp,
+            mask=first_mrow).astype(jnp.int32)
 
         s, L = self.num_slots, self.max_len
         p_pad = tokens.shape[1]
@@ -904,6 +1004,7 @@ class ServingEngine:
             "keys": jax.random.key_data(split[:, 0]),
             "top_k": top_k,
             "temp": temp,
+            "lmask": lmask,
         }
         if self.spec:
             out["draft_caches"] = draft_caches
@@ -954,6 +1055,7 @@ class ServingEngine:
             "keys": take(hstate["keys"], state["keys"]),
             "top_k": take(hstate["top_k"], state["top_k"]),
             "temp": take(hstate["temp"], state["temp"]),
+            "lmask": take(hstate["lmask"], state["lmask"]),
         }
         if self.spec:
             out["draft_caches"] = jax.tree.map(
@@ -997,6 +1099,35 @@ class ServingEngine:
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"request {request.uid!r}: max_new_tokens must be >= 1")
+        if request.logit_mask is not None:
+            m = np.asarray(request.logit_mask, bool)
+            if m.ndim != 2 or m.shape[1] != self.config.num_tokens:
+                raise ValueError(
+                    f"request {request.uid!r}: logit_mask must be "
+                    f"(G, {self.config.num_tokens}), got {m.shape}")
+            if m.shape[0] > request.max_new_tokens:
+                raise ValueError(
+                    f"request {request.uid!r}: logit_mask has {m.shape[0]} "
+                    f"rows but max_new_tokens={request.max_new_tokens}")
+            if n + m.shape[0] > self.max_len:
+                raise ValueError(
+                    f"request {request.uid!r}: mask rows run past max_len "
+                    f"{self.max_len} (prime {n} + {m.shape[0]} rows)")
+            if not m.any(axis=1).all():
+                raise ValueError(
+                    f"request {request.uid!r}: logit_mask has an all-False "
+                    f"row — every constrained position needs >= 1 allowed "
+                    f"token")
+            request.logit_mask = m
+        tenant = int(request.tenant)
+        if tenant != 0 and not self.lora:
+            raise ValueError(
+                f"request {request.uid!r}: tenant={tenant} but the engine "
+                f"was built without a lora_bank")
+        if not (0 <= tenant < self.num_tenants):
+            raise ValueError(
+                f"request {request.uid!r}: tenant {tenant} outside the "
+                f"bank's [0, {self.num_tenants})")
         if self.paged:
             stop = min(n + request.max_new_tokens, self.max_len)
             worst = pages_for_span(stop - 1, self.page_size)
@@ -1024,9 +1155,49 @@ class ServingEngine:
         self._tracer.event("serve.submit", trace=request.uid,
                            queue=len(self._queue))
 
+    def submit_embed(self, request: Request) -> None:
+        """Queue an EMBEDDING request: one prefill-shaped forward, mean-
+        pooled final hidden state, no decode slot consumed.  Same shed
+        rules as :meth:`submit`; ``max_new_tokens``/``top_k``/``temp``/
+        ``seed`` are ignored (nothing is sampled)."""
+        n = len(request.tokens)
+        if n < 1:
+            raise ValueError(f"request {request.uid!r}: empty prime")
+        if n > self.config.seq_len:
+            raise ValueError(
+                f"request {request.uid!r}: prime length {n} exceeds "
+                f"seq_len {self.config.seq_len}")
+        if request.logit_mask is not None:
+            raise ValueError(
+                f"request {request.uid!r}: embed requests take no "
+                f"logit_mask (nothing is sampled)")
+        if int(request.tenant) != 0:
+            raise ValueError(
+                f"request {request.uid!r}: embed requests run the base "
+                f"model (tenant must be 0)")
+        try:
+            self._guard("serve.submit")
+        except (_ContainedFault, RetryError):
+            self._shed(request, FAILED_FAULT)
+            return
+        deadline = self._deadline_of(request)
+        if deadline is not None and time.perf_counter() > deadline:
+            self._shed(request, SHED_DEADLINE)
+            return
+        if (self.max_queue is not None
+                and len(self._embed_queue) >= self.max_queue):
+            if self.shed_policy == "shed-oldest":
+                self._shed(self._embed_queue.popleft(), SHED_QUEUE_FULL)
+            else:
+                self._shed(request, SHED_QUEUE_FULL)
+                return
+        self._embed_queue.append(request)
+        self._tracer.event("serve.submit_embed", trace=request.uid,
+                           queue=len(self._embed_queue))
+
     @property
     def pending(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._embed_queue)
 
     @property
     def num_active(self) -> int:
@@ -1037,7 +1208,8 @@ class ServingEngine:
         """True while anything remains for ``step()`` to do or report —
         queued requests, in-flight slots, or shed completions not yet
         returned by a ``step()`` call."""
-        n = len(self._queue) + len(self._inflight) + len(self._pending)
+        n = (len(self._queue) + len(self._embed_queue)
+             + len(self._inflight) + len(self._pending))
         if self.disagg:
             n += len(self._handoff)
         return n > 0
@@ -1086,12 +1258,13 @@ class ServingEngine:
         prefill) and cancel expired in-flight slots (their partial tokens
         ride along in the shed completion)."""
         now = time.perf_counter()
-        expired_q = [r for r in self._queue
-                     if self._deadline_of(r) is not None
-                     and now > self._deadline_of(r)]
-        for r in expired_q:
-            self._queue.remove(r)
-            self._shed(r, SHED_DEADLINE)
+        for q in (self._queue, self._embed_queue):
+            expired_q = [r for r in q
+                         if self._deadline_of(r) is not None
+                         and now > self._deadline_of(r)]
+            for r in expired_q:
+                q.remove(r)
+                self._shed(r, SHED_DEADLINE)
         slots = [s for s, r in self._inflight.items()
                  if self._deadline_of(r) is not None
                  and now > self._deadline_of(r)]
@@ -1130,6 +1303,21 @@ class ServingEngine:
         else:
             self._admit_pending_dense()
 
+    def _build_lmask(self, rows: list) -> np.ndarray:
+        """``(S, max_len, V)`` write-position-indexed logit masks for the
+        rows being admitted (``rows`` pairs a slot/handle-row index with
+        its request).  Unconstrained rows stay all-True — bit-identical
+        to serving without masks at all.  Request row ``g`` constrains
+        the token written at absolute position ``len(prime) + g``."""
+        lmask = np.ones((self.num_slots, self.max_len,
+                         self.config.num_tokens), bool)
+        for idx, r in rows:
+            if r.logit_mask is not None:
+                m = np.asarray(r.logit_mask, bool)
+                p = len(r.tokens)
+                lmask[idx, p: p + m.shape[0]] = m
+        return lmask
+
     def _admit_pending_dense(self) -> None:
         free = [i for i in range(self.num_slots) if i not in self._inflight]
         if not free or not self._queue:
@@ -1149,6 +1337,7 @@ class ServingEngine:
         top_k = np.zeros((s,), np.int32)
         temp = np.ones((s,), np.float32)
         mask = np.zeros((s,), bool)
+        tenant = np.zeros((s,), np.int32)
         for slot, r in batch:
             t = np.asarray(r.tokens, np.int32)
             tokens[slot, : len(t)] = t
@@ -1158,14 +1347,18 @@ class ServingEngine:
             top_k[slot] = 0 if r.top_k is None else int(r.top_k)
             temp[slot] = float(r.temperature)
             mask[slot] = True
+            tenant[slot] = int(r.tenant)
             self._inflight[slot] = r
+        lmask = self._build_lmask(batch)
+        extra = (tenant,) if self.lora else ()
 
         t0 = time.perf_counter()
         try:
             with jax.profiler.TraceAnnotation("serve.admit_prefill"):
                 self.state = self._guard(
                     "serve.prefill", self._admit_call, tokens, lengths,
-                    stops, seeds, top_k, temp, mask, key=("admit", p_pad))
+                    stops, seeds, top_k, temp, mask, lmask, *extra,
+                    key=("admit", p_pad))
             self._note_stage("prefill_s", "serve.admit_prefill", t0,
                              uids=[r.uid for _, r in batch], p_pad=p_pad)
         except _ContainedFault:
@@ -1216,6 +1409,7 @@ class ServingEngine:
         top_k = np.zeros((s,), np.int32)
         temp = np.ones((s,), np.float32)
         mask = np.zeros((s,), bool)
+        tenant = np.zeros((s,), np.int32)
         wtable = np.full((s, self.pages_per_row), DUMP_PAGE, np.int32)
         pending_prefix: list[tuple[tuple, int]] = []
         for slot, r in batch:
@@ -1227,20 +1421,24 @@ class ServingEngine:
             top_k[slot] = 0 if r.top_k is None else int(r.top_k)
             temp[slot] = float(r.temperature)
             mask[slot] = True
+            tenant[slot] = int(r.tenant)
             self._inflight[slot] = r
             self._host_stop[slot] = stops[slot]
             self._admit_order[slot] = self._admit_seq
             self._admit_seq += 1
             self._paused[slot] = False
             self._plan_slot_pages(slot, r, p_pad, wtable, pending_prefix)
+        lmask = self._build_lmask(batch)
+        extra = (tenant,) if self.lora else ()
 
         t0 = time.perf_counter()
         try:
             with jax.profiler.TraceAnnotation("serve.admit_prefill"):
                 self.state = self._guard(
                     "serve.prefill", self._admit_call, tokens, lengths,
-                    stops, seeds, top_k, temp, mask,
-                    self._page_table.copy(), wtable, key=("admit", p_pad))
+                    stops, seeds, top_k, temp, mask, lmask,
+                    self._page_table.copy(), wtable, *extra,
+                    key=("admit", p_pad))
             self._note_stage("prefill_s", "serve.admit_prefill", t0,
                              uids=[r.uid for _, r in batch], p_pad=p_pad)
         except _ContainedFault:
@@ -1264,6 +1462,68 @@ class ServingEngine:
         # published for sharing
         for key, pid in pending_prefix:
             self._pool.register_prefix(key, pid)
+
+    # ---------------------------------------------------------- embeddings
+
+    def _embed_round(self) -> None:
+        """Serve one batch of embedding requests: a FIFO prefix of the
+        embed queue sharing the head's prefill bucket, padded to
+        ``embed_batch`` rows, one pooled forward, completions with the
+        ``(D,)`` vector attached.  No slot state is touched — embedding
+        traffic composes with any decode configuration."""
+        if not self._embed_queue:
+            return
+        try:
+            self._guard("serve.admit")
+        except _ContainedFault:
+            self._shed(self._embed_queue.popleft(), FAILED_FAULT)
+            return
+        cfg = self.config
+        p_pad = pad_prime_length(len(self._embed_queue[0].tokens),
+                                 cfg.window_size, cfg.seq_len, bucket=True)
+        batch: list[Request] = []
+        while (self._embed_queue and len(batch) < self.embed_batch
+               and pad_prime_length(len(self._embed_queue[0].tokens),
+                                    cfg.window_size, cfg.seq_len,
+                                    bucket=True) == p_pad):
+            batch.append(self._embed_queue.popleft())
+
+        b = self.embed_batch
+        tokens = np.zeros((b, p_pad), np.int32)
+        lengths = np.ones((b,), np.int32)  # dummy rows: 1-token prime
+        for row, r in enumerate(batch):
+            t = np.asarray(r.tokens, np.int32)
+            tokens[row, : len(t)] = t
+            lengths[row] = len(t)
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation("serve.embed"):
+                vecs = self._guard(
+                    "serve.embed", self._embed_call, tokens, lengths,
+                    key=("embed", p_pad))
+            self._note_stage("embed_s", "serve.embed", t0,
+                             uids=[r.uid for r in batch], p_pad=p_pad)
+        except _ContainedFault:
+            for r in batch:
+                self._shed(r, FAILED_FAULT)
+            return
+        except RetryError:
+            for r in reversed(batch):
+                self._embed_queue.appendleft(r)
+            raise
+        vecs = np.asarray(jax.device_get(  # graftcheck: disable=host-sync
+            vecs))
+        now = time.perf_counter()
+        for row, r in enumerate(batch):
+            comp = Completion(
+                uid=r.uid, prime=np.asarray(r.tokens, np.int32),
+                tokens=np.zeros((0,), np.int32), finish_reason="embed",
+                submit_time=r.submit_time, finish_time=now,
+                embedding=vecs[row])
+            self.completions.append(comp)
+            self._pending.append(comp)
+            if r.on_complete is not None:
+                r.on_complete(comp)
 
     # ------------------------------------------- disaggregated admission
 
@@ -1306,12 +1566,14 @@ class ServingEngine:
             seeds[row] = np.uint32(int(r.seed) & 0xFFFFFFFF)
             top_k[row] = 0 if r.top_k is None else int(r.top_k)
             temp[row] = float(r.temperature)
+        # handle-ROW-indexed, like every other slab the worker produces
+        lmask = self._build_lmask(list(enumerate(batch)))
         t0 = time.perf_counter()
         try:
             with jax.profiler.TraceAnnotation("serve.prefill"):
                 h = self._guard(
                     "serve.prefill", self._prefill_worker_call, tokens,
-                    lengths, stops, seeds, top_k, temp,
+                    lengths, stops, seeds, top_k, temp, lmask,
                     key=("prefill", p_pad))
             self._note_stage("prefill_s", "serve.prefill", t0,
                              uids=[r.uid for r in batch], p_pad=p_pad)
@@ -1667,6 +1929,12 @@ class ServingEngine:
             self._dispatch_chunk()
             completed += self._drain_pending()
             completed += self._harvest_done()
+        if self._embed_queue and not self._draining:
+            # embed AFTER the decode chunk for the same reason the disagg
+            # prefill round runs there: in-flight decode never stalls
+            # behind prefill-shaped work
+            self._embed_round()
+            completed += self._drain_pending()
         if self.disagg and not self._draining:
             # prefill AFTER the decode chunk: in-flight decode never
             # stalls behind a long prefill (the disaggregation p95 win);
@@ -1709,6 +1977,17 @@ class ServingEngine:
         if len(self._handoff) > before:
             return self._handoff.get()
         return None
+
+    @property
+    def embed_pending(self) -> int:
+        return len(self._embed_queue)
+
+    def run_embed_round(self) -> None:
+        """Serve one embedding batch (if queued).  The prefill-worker
+        process never calls ``step()``, so this is its path for running
+        embed traffic; completions land in the pending list and ship
+        home via :meth:`drain_sheds`."""
+        self._embed_round()
 
     def drain_sheds(self) -> list[Completion]:
         """Collect typed shed completions recorded since the last call
@@ -1785,6 +2064,10 @@ class ServingEngine:
                     entries.append(self._snap_request(r, []))
         for r in self._queue:
             entries.append(self._snap_request(r, []))
+        for r in self._embed_queue:
+            e = self._snap_request(r, [])
+            e["workload"] = "embed"
+            entries.append(e)
         snap = {"version": 1, "kind": "serving_snapshot",
                 "requests": entries}
         if path is not None:
@@ -1804,6 +2087,11 @@ class ServingEngine:
             "seed": int(r.seed),
             "generated": [int(t) for t in generated],
         }
+        if r.logit_mask is not None:
+            from progen_tpu.workloads.infill import mask_to_wire
+            entry["logit_mask"] = mask_to_wire(r.logit_mask)
+        if int(r.tenant) != 0:
+            entry["tenant"] = int(r.tenant)
         deadline = self._deadline_of(r)
         if deadline is not None:
             # perf_counter instants do not survive a process restart;
@@ -1823,30 +2111,42 @@ class ServingEngine:
                 snap = json.load(fh)
         if snap.get("kind") != "serving_snapshot":
             raise ValueError("not a serving snapshot")
-        if self._inflight or self._queue or \
+        if self._inflight or self._queue or self._embed_queue or \
                 (self.disagg and self._handoff):
             raise RuntimeError("restore() requires an idle engine")
         now = time.perf_counter()
         accepted = 0
         for e in snap["requests"]:
+            lmask = None
+            if e.get("logit_mask") is not None:
+                from progen_tpu.workloads.infill import mask_from_wire
+                lmask = mask_from_wire(e["logit_mask"],
+                                       self.config.num_tokens)
             r = Request(
                 uid=e["uid"], tokens=e["tokens"],
                 max_new_tokens=e["max_new_tokens"], top_k=e["top_k"],
                 temperature=e["temperature"], seed=e["seed"],
-                on_complete=on_complete, submit_time=now)
+                on_complete=on_complete, submit_time=now,
+                logit_mask=lmask, tenant=int(e.get("tenant", 0)))
             if "deadline_remaining" in e:
                 r.deadline = now + e["deadline_remaining"]
-            self.submit(r)
+            if e.get("workload") == "embed":
+                self.submit_embed(r)
+            else:
+                self.submit(r)
             accepted += 1
         return accepted
 
     # ----------------------------------------------------- warmup + counters
 
-    def aot_warmup(self, max_prime: int | None = None) -> dict:
+    def aot_warmup(self, max_prime: int | None = None, *,
+                   embed: bool = False) -> dict:
         """Explicitly compile the engine's whole program grid ahead of
         serving: one admission program per prefill bucket (``window *
         2^k`` up to ``max_prime``, default ``max_len - 1``) plus the
-        decode-chunk program, via ``jit(...).lower().compile()``.  The
+        decode-chunk program, via ``jit(...).lower().compile()``.  With
+        ``embed=True`` the per-bucket embedding programs compile too
+        (opt-in — engines that never see embed traffic skip the cost).  The
         compiled executables are dispatched directly afterwards, so a
         fresh (or restarted) process pays zero first-request compiles —
         the cold-start TTFT story (``benchmarks/bench_coldstart.py``).
@@ -1871,13 +2171,20 @@ class ServingEngine:
         u32 = partial(jax.ShapeDtypeStruct, dtype=jnp.uint32)
         f32 = partial(jax.ShapeDtypeStruct, dtype=jnp.float32)
         b8 = partial(jax.ShapeDtypeStruct, dtype=jnp.bool_)
+        L, V = self.max_len, self.config.num_tokens
         for p_pad in buckets:
+            if embed and ("embed", p_pad) not in self._aot:
+                tgt_sd = as_shape(self._target_params(self._params))
+                self._aot[("embed", p_pad)] = self._embedder.lower(
+                    tgt_sd, i32(s, p_pad), i32(s)).compile()
+                self._compiled_keys.add(("embed", p_pad))
+                programs += 1
             if self.disagg:
                 key = ("prefill", p_pad)
                 if key in self._aot:
                     continue
                 pre_args = [params_sd, i32(s, p_pad), i32(s), i32(s),
-                            u32((s,)), i32(s), f32((s,))]
+                            u32((s,)), i32(s), f32((s,)), b8((s, L, V))]
                 self._aot[key] = (
                     self._prefill_worker.lower(*pre_args).compile())
                 self._compiled_keys.add(key)
@@ -1887,10 +2194,13 @@ class ServingEngine:
             if key in self._aot:
                 continue
             admit_args = [params_sd, state_sd, i32(s, p_pad), i32(s),
-                          i32(s), u32((s,)), i32(s), f32((s,)), b8((s,))]
+                          i32(s), u32((s,)), i32(s), f32((s,)), b8((s,)),
+                          b8((s, L, V))]
             if self.paged:
                 admit_args += [i32(s, self.pages_per_row),
                                i32(s, self.pages_per_row)]
+            if self.lora:
+                admit_args += [i32(s)]
             self._aot[key] = self._admit.lower(*admit_args).compile()
             self._compiled_keys.add(key)
             programs += 1
@@ -1899,7 +2209,8 @@ class ServingEngine:
             # harvested to max_len), so any bucket's worker sizes it
             h_sd = jax.eval_shape(
                 self._prefill_worker_impl, params_sd, i32(s, buckets[0]),
-                i32(s), i32(s), u32((s,)), i32(s), f32((s,)))
+                i32(s), i32(s), u32((s,)), i32(s), f32((s,)),
+                b8((s, L, V)))
             gate_sd: dict = {}
             if self.paged:
                 gate_sd = h_sd["caches"]["sgu_gate"]

@@ -291,6 +291,12 @@ def request_to_wire(r, *, now: float | None = None) -> dict:
         # (docs/OBSERVABILITY.md)
         "trace": {"id": r.uid, "clock": now},
     }
+    if getattr(r, "logit_mask", None) is not None:
+        from progen_tpu.workloads.infill import mask_to_wire
+        entry["logit_mask"] = mask_to_wire(r.logit_mask)
+    tenant = int(getattr(r, "tenant", 0))
+    if tenant != 0:
+        entry["tenant"] = tenant
     deadline = r.deadline
     if deadline is None and r.ttl is not None:
         deadline = r.submit_time + r.ttl
@@ -300,19 +306,27 @@ def request_to_wire(r, *, now: float | None = None) -> dict:
 
 
 def request_from_wire(d: dict, *, now: float | None = None,
-                      on_complete=None):
+                      on_complete=None, vocab: int | None = None):
     """Rebuild a :class:`~progen_tpu.decode.engine.Request` in the
-    receiving process; the deadline resumes from its remaining budget."""
+    receiving process; the deadline resumes from its remaining budget.
+    ``vocab`` sizes a decoded infill mask (required when one rides)."""
     from progen_tpu.decode.engine import Request
 
     if now is None:
         now = time.perf_counter()
+    lmask = None
+    if d.get("logit_mask") is not None:
+        if vocab is None:
+            raise ValueError("request carries a logit_mask but the "
+                             "receiver passed no vocab size")
+        from progen_tpu.workloads.infill import mask_from_wire
+        lmask = mask_from_wire(d["logit_mask"], vocab)
     r = Request(
         uid=d["uid"], tokens=list(d["tokens"]),
         max_new_tokens=int(d["max_new_tokens"]),
         top_k=d.get("top_k"), temperature=float(d.get("temperature", 1.0)),
         seed=int(d.get("seed", 0)), on_complete=on_complete,
-        submit_time=now)
+        submit_time=now, logit_mask=lmask, tenant=int(d.get("tenant", 0)))
     if "deadline_remaining" in d:
         r.deadline = now + float(d["deadline_remaining"])
     return r

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from progen_tpu.core.precision import Policy, make_policy
-from progen_tpu.models.progen import ProGenConfig, _dense, _norm
+from progen_tpu.models.progen import ProGenConfig, _dense, _norm, apply_lora
 from progen_tpu.ops.local_attention import ATTN_MASK_VALUE
 from progen_tpu.ops.rotary import fixed_pos_embedding, rotate_every_two
 
@@ -136,7 +136,8 @@ class LocalAttentionDecode(nn.Module):
     policy: Policy
 
     @nn.compact
-    def __call__(self, x, sin_row, cos_row, slot, valid, prev, k_cache, v_cache):
+    def __call__(self, x, sin_row, cos_row, slot, valid, prev, k_cache, v_cache,
+                 adapters=None, tenant=None):
         h, d = self.heads, self.dim_head
         inner = h * d
         b = x.shape[0]
@@ -148,6 +149,8 @@ class LocalAttentionDecode(nn.Module):
 
         qkv = _dense(inner * 3, use_bias=False, axes=("embed", "qkv"),
                      policy=self.policy, name="to_qkv")(normed)
+        if adapters is not None:
+            qkv = apply_lora(qkv, normed, adapters["qkv"], tenant)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (t.reshape(b, h, d) for t in (q, k, v))
         q, k, v = (_rotate_at(t, sin_row, cos_row) for t in (q, k, v))
@@ -165,9 +168,11 @@ class LocalAttentionDecode(nn.Module):
             "bhs,bhsd->bhd", attn, v_cache,
             preferred_element_type=jnp.float32,
         ).astype(v_cache.dtype).reshape(b, inner)
-        out = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
-                     policy=self.policy, name="to_out")(out)
-        return out, new_prev, k_cache, v_cache
+        proj = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
+                      policy=self.policy, name="to_out")(out)
+        if adapters is not None:
+            proj = apply_lora(proj, out, adapters["out"], tenant)
+        return proj, new_prev, k_cache, v_cache
 
 
 class SGUDecode(nn.Module):
@@ -179,7 +184,7 @@ class SGUDecode(nn.Module):
     eps: float = 1e-3
 
     @nn.compact
-    def __call__(self, x, pos, gate_cache):
+    def __call__(self, x, pos, gate_cache, adapters=None, tenant=None):
         n = self.seq_len
         x, gate = jnp.split(x, 2, axis=-1)
         gate = _norm(self.policy, name="norm")(gate)
@@ -212,6 +217,8 @@ class SGUDecode(nn.Module):
         x = x * mixed
         out = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
                      policy=self.policy, name="proj_out")(x)
+        if adapters is not None:
+            out = apply_lora(out, x, adapters, tenant)
         return out, gate_cache
 
 
@@ -225,7 +232,7 @@ class FeedForwardDecode(nn.Module):
     policy: Policy
 
     @nn.compact
-    def __call__(self, x, pos, prev, gate_cache):
+    def __call__(self, x, pos, prev, gate_cache, adapters=None, tenant=None):
         hidden = self.dim * self.ff_mult * (2 if self.glu else 1)
 
         normed = _norm(self.policy, name="norm")(x)
@@ -245,7 +252,8 @@ class FeedForwardDecode(nn.Module):
             h, gate_cache = SGUDecode(
                 seq_len=self.seq_len, dim_out=hidden // 2,
                 policy=self.policy, name="sgu",
-            )(h, pos, gate_cache)
+            )(h, pos, gate_cache,
+              None if adapters is None else adapters["sgu"], tenant)
 
         out = _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
                      policy=self.policy, name="proj_out")(h)
@@ -265,7 +273,7 @@ class ProGenDecodeStep(nn.Module):
     policy: Policy = dataclasses.field(default_factory=make_policy)
 
     @nn.compact
-    def __call__(self, tok, pos, caches):
+    def __call__(self, tok, pos, caches, adapters=None, tenant=None):
         cfg, pol = self.config, self.policy
         wsz = cfg.window_size
         ring = 2 * wsz
@@ -305,13 +313,16 @@ class ProGenDecodeStep(nn.Module):
 
         for i in range(cfg.depth):
             use_gmlp = cfg.layer_uses_gmlp(i)
+            attn_ad = None if adapters is None else adapters.get(f"attn{i}")
+            ff_ad = None if adapters is None else adapters.get(f"ff{i}")
             attn_out, new["attn_prev"][i], new["k"][i], new["v"][i] = (
                 LocalAttentionDecode(
                     dim=cfg.dim, window_size=wsz, heads=cfg.heads,
                     dim_head=cfg.dim_head, shift=cfg.shift_tokens,
                     policy=pol, name=f"attn{i}",
                 )(x, sin_row, cos_row, slot, valid,
-                  caches["attn_prev"][i], caches["k"][i], caches["v"][i])
+                  caches["attn_prev"][i], caches["k"][i], caches["v"][i],
+                  attn_ad, tenant)
             )
             x = x + attn_out
 
@@ -321,7 +332,8 @@ class ProGenDecodeStep(nn.Module):
                 glu=(not use_gmlp) and cfg.ff_glu, use_sgu=use_gmlp,
                 shift=cfg.shift_tokens, policy=pol, name=f"ff{i}",
             )(x, pos, caches["ff_prev"][i],
-              gate_cache if gate_cache is not None else jnp.zeros(()))
+              gate_cache if gate_cache is not None else jnp.zeros(()),
+              ff_ad, tenant)
             x = x + ff_out
             if str(i) in new["sgu_gate"]:
                 new["sgu_gate"][str(i)] = gate_cache
@@ -352,7 +364,8 @@ class SGUDecodePaged(nn.Module):
     eps: float = 1e-3
 
     @nn.compact
-    def __call__(self, x, pos, pool, table, write_ok):
+    def __call__(self, x, pos, pool, table, write_ok, adapters=None,
+                 tenant=None):
         from progen_tpu.ops.pallas_paged_attention import (
             paged_gate_mix, write_gate_row)
 
@@ -379,6 +392,8 @@ class SGUDecodePaged(nn.Module):
         x = x * mixed
         out = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
                      policy=self.policy, name="proj_out")(x)
+        if adapters is not None:
+            out = apply_lora(out, x, adapters, tenant)
         return out, pool
 
 
@@ -395,7 +410,8 @@ class FeedForwardDecodePaged(nn.Module):
     impl: str = "xla"
 
     @nn.compact
-    def __call__(self, x, pos, prev, pool, table, write_ok):
+    def __call__(self, x, pos, prev, pool, table, write_ok, adapters=None,
+                 tenant=None):
         hidden = self.dim * self.ff_mult
 
         normed = _norm(self.policy, name="norm")(x)
@@ -410,7 +426,8 @@ class FeedForwardDecodePaged(nn.Module):
         h, pool = SGUDecodePaged(
             seq_len=self.seq_len, dim_out=hidden // 2, n_rows=self.n_rows,
             policy=self.policy, impl=self.impl, name="sgu",
-        )(h, pos, pool, table, write_ok)
+        )(h, pos, pool, table, write_ok,
+          None if adapters is None else adapters["sgu"], tenant)
 
         out = _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
                      policy=self.policy, name="proj_out")(h)
@@ -435,7 +452,8 @@ class ProGenPagedDecodeStep(nn.Module):
     impl: str = "xla"
 
     @nn.compact
-    def __call__(self, tok, pos, caches, table, write_ok):
+    def __call__(self, tok, pos, caches, table, write_ok, adapters=None,
+                 tenant=None):
         cfg, pol = self.config, self.policy
         wsz = cfg.window_size
         ring = 2 * wsz
@@ -470,13 +488,16 @@ class ProGenPagedDecodeStep(nn.Module):
 
         for i in range(cfg.depth):
             use_gmlp = cfg.layer_uses_gmlp(i)
+            attn_ad = None if adapters is None else adapters.get(f"attn{i}")
+            ff_ad = None if adapters is None else adapters.get(f"ff{i}")
             attn_out, new["attn_prev"][i], new["k"][i], new["v"][i] = (
                 LocalAttentionDecode(
                     dim=cfg.dim, window_size=wsz, heads=cfg.heads,
                     dim_head=cfg.dim_head, shift=cfg.shift_tokens,
                     policy=pol, name=f"attn{i}",
                 )(x, sin_row, cos_row, slot, valid,
-                  caches["attn_prev"][i], caches["k"][i], caches["v"][i])
+                  caches["attn_prev"][i], caches["k"][i], caches["v"][i],
+                  attn_ad, tenant)
             )
             x = x + attn_out
 
@@ -487,14 +508,15 @@ class ProGenPagedDecodeStep(nn.Module):
                         n_rows=self.n_rows, shift=cfg.shift_tokens,
                         policy=pol, impl=self.impl, name=f"ff{i}",
                     )(x, pos, caches["ff_prev"][i],
-                      caches["sgu_pool"][str(i)], table, write_ok)
+                      caches["sgu_pool"][str(i)], table, write_ok,
+                      ff_ad, tenant)
                 )
             else:
                 ff_out, new["ff_prev"][i], _ = FeedForwardDecode(
                     dim=cfg.dim, seq_len=cfg.seq_len, ff_mult=cfg.ff_mult,
                     glu=cfg.ff_glu, use_sgu=False,
                     shift=cfg.shift_tokens, policy=pol, name=f"ff{i}",
-                )(x, pos, caches["ff_prev"][i], jnp.zeros(()))
+                )(x, pos, caches["ff_prev"][i], jnp.zeros(()), ff_ad, tenant)
             x = x + ff_out
 
         h = _norm(pol, name="norm_out")(x)

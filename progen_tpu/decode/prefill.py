@@ -251,6 +251,60 @@ def scatter_gate_rows(config: ProGenConfig, gate_rows: dict, lengths,
     return new_pool
 
 
+def make_embedder(config: ProGenConfig, policy: Policy | None = None,
+                  mesh: Mesh | None = None,
+                  strategies: Sequence[str] = ("dp",)):
+    """Build ``embed(params, tokens, lengths) -> (B, dim) f32``: the
+    embeddings-endpoint program.
+
+    Reuses the one-pass prefill forward with ``sow_final_hidden=True`` —
+    the model sows ONLY the final post-norm hidden states (no per-layer
+    decode carries are materialized; the unused logits head is dead code
+    XLA eliminates) — then mean-pools over each row's real positions
+    (``< lengths[b]``; the window-aligned pad tail never contributes).
+    Same ragged ``(B, P_pad)`` + ``lengths`` contract as
+    :func:`make_prefiller`, so the serving engine warms one embed program
+    per prime bucket.
+    """
+    policy = policy or make_policy()
+    model = ProGen(config=config, policy=policy, mesh=None,
+                   sow_final_hidden=True)
+
+    if mesh is not None:
+        from progen_tpu.parallel.sharding import logical_rules
+
+        rules = logical_rules(strategies)
+        jit_kwargs = {"out_shardings": NamedSharding(mesh, PartitionSpec())}
+
+        def trace_ctx():
+            stack = contextlib.ExitStack()
+            stack.enter_context(mesh)
+            stack.enter_context(nn.logical_axis_rules(rules))
+            return stack
+    else:
+        jit_kwargs = {}
+        trace_ctx = contextlib.ExitStack
+
+    @partial(jax.jit, **jit_kwargs)
+    def embed(params, tokens, lengths):
+        b, p_pad = tokens.shape
+        if p_pad % config.window_size != 0 or p_pad > config.seq_len:
+            raise ValueError(
+                f"padded prime length {p_pad} must be a multiple of "
+                f"window_size {config.window_size} and <= seq_len "
+                f"{config.seq_len}"
+            )
+        lengths = jnp.asarray(lengths, jnp.int32)
+        with trace_ctx():
+            _, varz = model.apply(params, tokens, mutable=["cache"])
+        h = varz["cache"]["final_hidden"][0].astype(jnp.float32)
+        keep = (jnp.arange(p_pad)[None, :] < lengths[:, None])
+        pooled = jnp.sum(h * keep[:, :, None].astype(jnp.float32), axis=1)
+        return pooled / jnp.maximum(lengths, 1).astype(jnp.float32)[:, None]
+
+    return embed
+
+
 def make_prefiller(config: ProGenConfig, policy: Policy | None = None,
                    mesh: Mesh | None = None,
                    strategies: Sequence[str] = ("dp",)):

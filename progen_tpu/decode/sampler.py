@@ -38,26 +38,45 @@ from progen_tpu.decode.prefill import (
 from progen_tpu.models.progen import ProGenConfig
 
 
-def gumbel_topk_sample(key, logits, top_k: int | None, temperature: float = 1.0):
+def apply_logit_mask(logits, mask):
+    """The one ``-inf`` masking idiom: keep ``logits`` where ``mask`` is
+    true, ``-inf`` elsewhere.  Both the top-k cut and the infilling
+    alphabet constraints route through here, so "never emits a masked
+    token" is a property of a single expression.  An all-true mask
+    returns ``logits`` bit-identically (``jnp.where`` selects, never
+    recomputes)."""
+    return jnp.where(mask, logits, -jnp.inf)
+
+
+def gumbel_topk_sample(key, logits, top_k: int | None, temperature: float = 1.0,
+                       mask=None):
     """Sample token ids ``(B,)`` from logits ``(B, V)``.
 
     Runs in f32 regardless of the logits dtype: bf16 logits under a tiny
     temperature overflow to inf (and the ``-inf`` top-k mask then yields
     ``inf - inf = NaN`` rows), so the division, masking and gumbel noise
     all happen after an f32 cast.
+
+    ``mask`` (optional, broadcastable to ``logits``, bool): tokens with a
+    false entry can never be emitted — applied before the greedy branch so
+    ``temperature=0`` respects it too.  Masked entries survive the top-k
+    cut as ``-inf`` (``-inf >= kth`` only when ``kth`` is itself ``-inf``,
+    which keeps them ``-inf``), so top-k and constraints compose.
     """
     logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = apply_logit_mask(logits, mask)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        logits = apply_logit_mask(logits, logits >= kth)
     noise = jax.random.gumbel(key, logits.shape, dtype=logits.dtype)
     return jnp.argmax(logits + noise, axis=-1)
 
 
-def gumbel_topk_sample_batched(keys, logits, top_k, temperature):
+def gumbel_topk_sample_batched(keys, logits, top_k, temperature, mask=None):
     """Per-row sampling for the serving engine: each row has its own key,
     top-k and temperature.
 
@@ -66,15 +85,23 @@ def gumbel_topk_sample_batched(keys, logits, top_k, temperature):
     ``(B,)`` f32, ``0.0`` means greedy for that row.  Dynamic per-row k
     uses a full sort instead of ``lax.top_k`` (whose k is static) — V is
     small (vocab 256) so the sort is noise next to the model step.
+
+    ``mask`` (optional ``(B, V)`` bool): per-row allowed-token constraint,
+    applied before the greedy argmax so greedy rows respect it too.  A
+    ``-inf``-masked entry divides to ``-inf``, survives the per-row k cut
+    as ``-inf`` and loses every argmax, so constraints compose with
+    per-row top-k exactly as in :func:`gumbel_topk_sample`.
     """
     logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = apply_logit_mask(logits, mask)
     v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temperature, 1e-8)[:, None]
     k_eff = jnp.where(top_k > 0, jnp.clip(top_k, 1, v), v)
     srt = jnp.sort(scaled, axis=-1)  # ascending
     kth = jnp.take_along_axis(srt, (v - k_eff)[:, None], axis=-1)
-    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    masked = apply_logit_mask(scaled, scaled >= kth)
     noise = jax.vmap(
         lambda k: jax.random.gumbel(k, (v,), jnp.float32))(keys)
     sampled = jnp.argmax(masked + noise, axis=-1)
@@ -256,7 +283,7 @@ def make_chunked_sampler(config: ProGenConfig, policy: Policy | None = None,
     @partial(jax.jit,
              static_argnames=("length", "start_pos", "top_k", "temperature"))
     def start_state(key, prime, last_logits, length, start_pos, top_k,
-                    temperature):
+                    temperature, first_mask=None):
         b = prime.shape[0]
         seq = jnp.zeros((b, length), jnp.int32)
         seq = jax.lax.dynamic_update_slice(seq, prime.astype(jnp.int32), (0, 0))
@@ -268,7 +295,8 @@ def make_chunked_sampler(config: ProGenConfig, policy: Policy | None = None,
             key, _ = jax.lax.scan(burn, key, None, length=start_pos - 1)
         key, sub = jax.random.split(key)
         first = gumbel_topk_sample(sub, last_logits, top_k,
-                                   temperature).astype(jnp.int32)
+                                   temperature, mask=first_mask).astype(
+                                       jnp.int32)
         zcount = jnp.sum(prime == 0, axis=1).astype(jnp.int32)
         if start_pos < length:
             val = jnp.where(zcount > 1, 0, first)
@@ -279,7 +307,7 @@ def make_chunked_sampler(config: ProGenConfig, policy: Policy | None = None,
     @partial(jax.jit,
              static_argnames=("length", "start_pos", "top_k", "temperature"))
     def decode_chunk(params, seq, caches, key, zcount, pos0, length,
-                     start_pos, top_k, temperature):
+                     start_pos, top_k, temperature, logit_mask=None):
         with trace_ctx():
             if mesh is not None:
                 caches = _constrain_caches(caches, mesh, strategies)
@@ -291,12 +319,19 @@ def make_chunked_sampler(config: ProGenConfig, policy: Policy | None = None,
                                                    keepdims=False)
                 logits, caches = step_model.apply(params, tok, pos, caches)
                 key, sub = jax.random.split(key)
-                nxt = gumbel_topk_sample(sub, logits, top_k,
-                                         temperature).astype(jnp.int32)
-                val = jnp.where(zcount > 1, 0, nxt)
                 raw = pos0 + i + 1
                 write = (raw >= start_pos) & (raw < length)
                 idx = jnp.minimum(raw, length - 1)
+                # the mask row for the position being WRITTEN (absolute
+                # index), same gather the serving engine does per slot
+                mrow = None
+                if logit_mask is not None:
+                    mrow = jax.lax.dynamic_index_in_dim(
+                        logit_mask, idx, axis=1, keepdims=False)
+                nxt = gumbel_topk_sample(sub, logits, top_k,
+                                         temperature, mask=mrow).astype(
+                                             jnp.int32)
+                val = jnp.where(zcount > 1, 0, nxt)
                 cur = jax.lax.dynamic_index_in_dim(seq, idx, axis=1,
                                                    keepdims=False)
                 out = jnp.where(write, val, cur)
@@ -311,7 +346,7 @@ def make_chunked_sampler(config: ProGenConfig, policy: Policy | None = None,
         return seq, caches, key, zcount, jnp.all(zcount > 1)
 
     def sample(params, key, prime, length, top_k=None, add_bos=False,
-               temperature=1.0):
+               temperature=1.0, logit_mask=None):
         if prime.ndim != 2:
             raise ValueError(f"prime must be (B, P), got {prime.shape}")
         if params_shardings is not None:
@@ -329,21 +364,32 @@ def make_chunked_sampler(config: ProGenConfig, policy: Policy | None = None,
                 f"need 0 < prime length {start_pos} <= length {length} <= "
                 f"seq_len {config.seq_len}"
             )
+        if logit_mask is not None:
+            logit_mask = jnp.asarray(logit_mask, bool)
+            if logit_mask.shape != (b, length, config.num_tokens):
+                raise ValueError(
+                    f"logit_mask must be (B={b}, length={length}, "
+                    f"V={config.num_tokens}), got {logit_mask.shape}"
+                )
 
         p_pad = pad_prime_length(start_pos, config.window_size, config.seq_len)
         tokens = jnp.pad(prime, ((0, 0), (0, p_pad - start_pos)))
         lengths = jnp.full((b,), start_pos, jnp.int32)
         last_logits, caches = prefiller(params, tokens, lengths,
                                         decode_len=length)
+        first_mask = None
+        if logit_mask is not None and start_pos < length:
+            first_mask = logit_mask[:, start_pos]
         seq, key, zcount = start_state(
-            key, prime, last_logits, length, start_pos, top_k, temperature)
+            key, prime, last_logits, length, start_pos, top_k, temperature,
+            first_mask)
 
         n_chunks = 0
         pos = start_pos
         while pos < length:
             seq, caches, key, zcount, done = decode_chunk(
                 params, seq, caches, key, zcount, pos, length, start_pos,
-                top_k, temperature)
+                top_k, temperature, logit_mask)
             n_chunks += 1
             pos += chunk_size
             if bool(done):

@@ -110,17 +110,31 @@ def spec_round(state: dict, *, spec_k: int, max_len: int, eos_id: int,
     s = state["pos"].shape[0]
     pos0 = state["pos"]
     tok0 = jnp.take_along_axis(state["seq"], pos0[:, None], axis=1)[:, 0]
+    # infilling logit masks ride the slot state as (S, max_len, V) rows
+    # indexed by WRITE position; absent for direct callers (None = all-pass)
+    lmask = state.get("lmask")
+
+    def mask_rows(writepos):
+        if lmask is None:
+            return None
+        return jnp.take_along_axis(
+            lmask, writepos[:, None, None], axis=1)[:, 0]
 
     # -- draft propose: throwaway cache copy, re-derived key chain.  The
     # chain advances unconditionally (dead slots' proposals are garbage
     # and never consumed); positions clamp so a slot racing past its stop
-    # mid-round cannot index past the gMLP weight rows.
+    # mid-round cannot index past the gMLP weight rows.  The mask row for
+    # the position the TARGET would write this step applies to the draft
+    # sample too — draft and target see identical constrained logits, so
+    # acceptance (and therefore token-identity) is preserved under masks.
     def propose_body(carry, _):
         dc, kd, tok, dpos = carry
         logits, dc = draft_step(tok, dpos, dc)
         kd, sub = split_keys_batched(kd)
         d = gumbel_topk_sample_batched(
-            sub, logits, state["top_k"], state["temp"]).astype(jnp.int32)
+            sub, logits, state["top_k"], state["temp"],
+            mask=mask_rows(jnp.clip(dpos + 1, 0, max_len - 1))).astype(
+                jnp.int32)
         return (dc, kd, d, jnp.minimum(dpos + 1, max_len - 1)), d
 
     (_, _, _, _), proposed = jax.lax.scan(
@@ -142,9 +156,10 @@ def spec_round(state: dict, *, spec_k: int, max_len: int, eos_id: int,
         logits, caches = target_step(inp, pos, st["caches"], live)
         caches = merge_caches(live, caches, st["caches"])
         kd, sub = split_keys_batched(st["keys"])
-        nxt = gumbel_topk_sample_batched(
-            sub, logits, st["top_k"], st["temp"]).astype(jnp.int32)
         writepos = jnp.clip(pos + 1, 0, max_len - 1)
+        nxt = gumbel_topk_sample_batched(
+            sub, logits, st["top_k"], st["temp"],
+            mask=mask_rows(writepos)).astype(jnp.int32)
         cur = jnp.take_along_axis(st["seq"], writepos[:, None],
                                   axis=1)[:, 0]
         val = jnp.where(live, nxt, cur)

@@ -83,6 +83,32 @@ class ProGenConfig:
         return (self.depth - i) <= self.global_mlp_depth
 
 
+def lora_delta(x, site, tenant):
+    """Batched multi-tenant LoRA delta for one adapter site.
+
+    ``x``: the dense layer's input ``(B, ..., Din)``; ``site``: stacked
+    per-tenant factors ``{"a": (T, Din, r), "b": (T, r, Dout)}`` (any
+    scale/alpha already folded into ``b`` by the converter); ``tenant``:
+    ``(B,)`` int32 tenant ids.  Each batch row gathers ITS tenant's
+    factors, so one decode step serves every tenant in the batch — the
+    einsum contracts over the rank dim per row, no per-tenant dispatch.
+    """
+    a = jnp.take(site["a"], tenant, axis=0).astype(x.dtype)
+    b = jnp.take(site["b"], tenant, axis=0).astype(x.dtype)
+    h = jnp.einsum("b...d,bdr->b...r", x, a)
+    return jnp.einsum("b...r,bro->b...o", h, b)
+
+
+def apply_lora(base, x, site, tenant):
+    """``base + lora_delta`` for rows with ``tenant > 0``; rows with
+    tenant 0 return ``base`` BIT-identically.  The guard is a ``where`` on
+    the output, not a zero delta: ``base + 0.0`` flips ``-0.0`` outputs to
+    ``+0.0``, which would break the zero-adapter == base-model identity."""
+    delta = lora_delta(x, site, tenant)
+    live = (tenant > 0).reshape((-1,) + (1,) * (base.ndim - 1))
+    return jnp.where(live, base + delta, base)
+
+
 def _norm(policy: Policy, name: str | None = None) -> nn.LayerNorm:
     # Scale-only LayerNorm, eps matching Haiku's default (reference
     # ``progen.py:22``: create_scale=True, create_offset=False).
@@ -128,9 +154,10 @@ class LocalAttention(nn.Module):
     policy: Policy
     attn_impl: str = "xla"  # "xla" | "pallas"
     mesh: Mesh | None = None  # seq axis >1 -> context-parallel halo path
+    sow_caches: bool = True  # False: skip decode-carry sows (embeddings path)
 
     @nn.compact
-    def __call__(self, x, sin, cos):
+    def __call__(self, x, sin, cos, adapters=None, tenant=None):
         b, n, _ = x.shape
         h, d = self.heads, self.dim_head
         inner = h * d
@@ -140,13 +167,15 @@ class LocalAttention(nn.Module):
         # (harvested by decode/prefill.py when the "cache" collection is
         # mutable; a no-op otherwise, and skipped at init so the variable
         # tree stays params-only)
-        if not self.is_initializing():
+        if self.sow_caches and not self.is_initializing():
             self.sow("cache", "prev", x)
         if self.shift:
             x = shift_tokens(x)
 
         qkv = _dense(inner * 3, use_bias=False, axes=("embed", "qkv"),
                      policy=self.policy, name="to_qkv")(x)
+        if adapters is not None:
+            qkv = apply_lora(qkv, x, adapters["qkv"], tenant)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # (B, L, H*D) -> (B, H, L, D)
         q, k, v = (
@@ -165,7 +194,7 @@ class LocalAttention(nn.Module):
         v = nn.with_logical_constraint(v, ("act_batch", "act_heads", "act_seq", None))
         # post-rotary k/v per position: exactly what the decode ring buffers
         # hold (decode/incremental.py) — prefill harvests these
-        if not self.is_initializing():
+        if self.sow_caches and not self.is_initializing():
             self.sow("cache", "k", k)
             self.sow("cache", "v", v)
 
@@ -200,8 +229,11 @@ class LocalAttention(nn.Module):
             )
         out = out.transpose(0, 2, 1, 3).reshape(b, n, inner)
         out = checkpoint_name(out, "attn_out")
-        return _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
-                      policy=self.policy, name="to_out")(out)
+        y = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
+                   policy=self.policy, name="to_out")(out)
+        if adapters is not None:
+            y = apply_lora(y, out, adapters["out"], tenant)
+        return y
 
 
 class SGU(nn.Module):
@@ -218,15 +250,16 @@ class SGU(nn.Module):
     eps: float = 1e-3
     sgu_impl: str = "xla"  # "xla" | "pallas" (blocked-causal fused kernel)
     mesh: Mesh | None = None  # seq axis >1 -> sharded spatial matmul
+    sow_caches: bool = True
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapters=None, tenant=None):
         n = self.seq_len
         x, gate = jnp.split(x, 2, axis=-1)
         gate = _norm(self.policy, name="norm")(gate)
         # normed gate activations per position: the decode SGU gate cache
         # rows (decode/incremental.py SGUDecode) — prefill harvests these
-        if not self.is_initializing():
+        if self.sow_caches and not self.is_initializing():
             self.sow("cache", "gate", gate)
 
         init_scale = self.eps / n
@@ -301,8 +334,11 @@ class SGU(nn.Module):
             else:
                 gate = spatial_gate(gate, w, b)
                 x = x * gate
-        return _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
-                      policy=self.policy, name="proj_out")(x)
+        y = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
+                   policy=self.policy, name="proj_out")(x)
+        if adapters is not None:
+            y = apply_lora(y, x, adapters, tenant)
+        return y
 
 
 class FeedForward(nn.Module):
@@ -321,14 +357,15 @@ class FeedForward(nn.Module):
     policy: Policy
     sgu_impl: str = "xla"
     mesh: Mesh | None = None
+    sow_caches: bool = True
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapters=None, tenant=None):
         assert not (self.glu and self.use_sgu)
         hidden = self.dim * self.ff_mult * (2 if self.glu else 1)
 
         x = _norm(self.policy, name="norm")(x)
-        if not self.is_initializing():
+        if self.sow_caches and not self.is_initializing():
             self.sow("cache", "prev", x)
         if self.shift:
             x = shift_tokens(x)
@@ -346,7 +383,11 @@ class FeedForward(nn.Module):
         if self.use_sgu:
             x = SGU(seq_len=self.seq_len, dim_out=hidden // 2,
                     policy=self.policy, sgu_impl=self.sgu_impl,
-                    mesh=self.mesh, name="sgu")(x)
+                    mesh=self.mesh, sow_caches=self.sow_caches,
+                    name="sgu")(
+                        x,
+                        None if adapters is None else adapters["sgu"],
+                        tenant)
 
         return _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
                       policy=self.policy, name="proj_out")(x)
@@ -385,10 +426,18 @@ class ProGen(nn.Module):
     # (shard_map + ppermute/all_gather) instead of relying on GSPMD to invent
     # collectives for the window structure.
     mesh: Mesh | None = None
+    # Embeddings-endpoint switch: sow ONLY the final post-norm hidden states
+    # (collection "cache", name "final_hidden") and skip every per-layer
+    # decode-carry sow, so the embed program materializes one (B, L, D)
+    # tensor instead of full decode caches.  False (the default) is
+    # byte-identical to the pre-switch model for all existing callers.
+    sow_final_hidden: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, adapters=None, tenant=None):
         cfg = self.config
+        if adapters is not None and tenant is None:
+            raise ValueError("adapters require a (B,) tenant-id array")
         if tokens.ndim != 2:
             raise ValueError(
                 f"ProGen takes batched (B, L) int tokens, got shape {tokens.shape}; "
@@ -442,8 +491,11 @@ class ProGen(nn.Module):
             attn_cls = LocalAttention
             ff_cls = FeedForward
 
+        sow_caches = not self.sow_final_hidden
         for i in range(cfg.depth):
             use_gmlp = cfg.layer_uses_gmlp(i)
+            attn_ad = None if adapters is None else adapters.get(f"attn{i}")
+            ff_ad = None if adapters is None else adapters.get(f"ff{i}")
             x = x + attn_cls(
                 dim=cfg.dim,
                 window_size=cfg.window_size,
@@ -453,8 +505,9 @@ class ProGen(nn.Module):
                 policy=self.policy,
                 attn_impl=self.attn_impl,
                 mesh=self.mesh,
+                sow_caches=sow_caches,
                 name=f"attn{i}",
-            )(x, sin, cos)
+            )(x, sin, cos, attn_ad, tenant)
             x = x + ff_cls(
                 dim=cfg.dim,
                 seq_len=cfg.seq_len,
@@ -465,11 +518,14 @@ class ProGen(nn.Module):
                 policy=self.policy,
                 sgu_impl=self.sgu_impl,
                 mesh=self.mesh,
+                sow_caches=sow_caches,
                 name=f"ff{i}",
-            )(x)
+            )(x, ff_ad, tenant)
             x = nn.with_logical_constraint(x, ("act_batch", "act_seq", "act_embed"))
 
         x = _norm(self.policy, name="norm_out")(x)
+        if self.sow_final_hidden and not self.is_initializing():
+            self.sow("cache", "final_hidden", x)
         logits = _dense(cfg.num_tokens, use_bias=True, axes=("embed", "vocab"),
                         policy=self.policy, name="to_logits")(x)
         return self.policy.cast_to_output(logits)

@@ -54,13 +54,15 @@ def _completion_from_wire(header: dict, submit_time: float,
                           finish_time: float) -> Completion:
     """Wire message → Completion (module-level: builds numpy arrays, so
     it stays OUTSIDE the cluster's host-sync zone)."""
+    emb = header.get("embedding")
     return Completion(
         uid=header["uid"],
         prime=np.asarray(header.get("prime", []), np.int32),
         tokens=np.asarray(header.get("tokens", []), np.int32),
         finish_reason=header["finish_reason"],
         submit_time=submit_time, finish_time=finish_time,
-        status=header.get("status", "ok"))
+        status=header.get("status", "ok"),
+        embedding=None if emb is None else np.asarray(emb, np.float32))
 
 
 def _shed_completion(request, status: str, now: float) -> Completion:
@@ -229,6 +231,15 @@ class ServeCluster:
         self._tracer.add("cluster.submit", now,
                          time.perf_counter() - now, trace=request.uid)
 
+    def submit_embed(self, request: Request) -> None:
+        """Route one EMBEDDING request.  Embed traffic is its own request
+        class: it rides a prefill worker's engine (prefill-shaped
+        forward, no decode slots, no handle), so the router's prefill
+        stage bookkeeping covers its whole lifecycle — completion,
+        requeue-on-death, shedding all reuse the generate paths."""
+        request.workload = "embed"
+        self.submit(request)
+
     def _dispatch(self, uid, now: float) -> None:
         request = self.router.requests[uid]
         deadline = _deadline_of(request)
@@ -249,7 +260,9 @@ class ServeCluster:
             # raced a death the event queue has not surfaced yet; the
             # dead-peer path will pick the uid up via fail_worker
             return
-        peer.send_json({"type": "req",
+        kind = "embed_req" if getattr(request, "workload",
+                                      "generate") == "embed" else "req"
+        peer.send_json({"type": kind,
                         "req": request_to_wire(request, now=now)})
 
     def _shed(self, uid, status: str, now: float) -> None:

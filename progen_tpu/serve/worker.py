@@ -101,7 +101,7 @@ def build_engine_from_spec(spec: dict, *, remote_prefill: bool = False):
 
 
 def _completion_to_wire(c) -> dict:
-    return {
+    msg = {
         "type": "completion",
         "uid": c.uid,
         "prime": [int(t) for t in c.prime],
@@ -110,6 +110,9 @@ def _completion_to_wire(c) -> dict:
         "status": c.status,
         "worker_latency": float(c.latency),
     }
+    if c.embedding is not None:
+        msg["embedding"] = [float(x) for x in c.embedding]
+    return msg
 
 
 def _drain_inbox(inbox, *, timeout: float):
@@ -167,13 +170,23 @@ def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
         for header, _ in msgs:
             t = header.get("type")
             if t == "req":
-                eng.submit(request_from_wire(header["req"]))
+                eng.submit(request_from_wire(
+                    header["req"], vocab=eng.config.num_tokens))
+            elif t == "embed_req":
+                eng.submit_embed(request_from_wire(
+                    header["req"], vocab=eng.config.num_tokens))
             elif t == "ack":
                 unacked.discard(header.get("batch_id"))
             elif t == "shutdown":
                 running = False
             elif t == "stats_req":
                 peer.send_json(_stats_frame(eng, counters))
+        # embed traffic shares this worker's prefill-shaped programs but
+        # needs no ack credits — completions ship straight home
+        while eng.embed_pending:
+            eng.run_embed_round()
+            for c in eng.drain_sheds():
+                peer.send_json(_completion_to_wire(c))
         if eng.pending and len(unacked) >= window:
             if stall_t0 is None:
                 stall_t0 = time.perf_counter()

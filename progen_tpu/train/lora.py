@@ -1,0 +1,201 @@
+"""Frozen-base LoRA fine-tuning through the existing train loop.
+
+A tenant's adapter is trained as a thin flax wrapper (:class:`LoRAProGen`)
+around the unchanged :class:`~progen_tpu.models.progen.ProGen` forward: the
+wrapper declares one ``(d_in, rank)`` / ``(rank, d_out)`` factor pair per
+serving site (``workloads/lora.lora_sites``) and feeds them through the SAME
+``apply_lora`` path the decode step uses, as a two-tenant stacked bank whose
+row 0 is zero and whose row 1 holds the live factors.  Training therefore
+exercises exactly the serving math — no train/serve drift to reconcile when
+the factors are converted into a multi-tenant bank.
+
+Freezing is an optimizer property, not a ``stop_gradient`` in the model:
+``optax.multi_transform`` routes the base subtree to ``set_to_zero`` and the
+adapter leaves to the real optimizer, so ``make_train_functions`` (and with
+it the Trainer's fused superstep path, ``train_multi_step``) runs unmodified
+and the base params stay BIT-identical across any number of steps.
+
+Serving hand-off: ``extract_adapters`` pulls the trained factor tree out of
+the wrapper's params; ``workloads/lora.bank_from_trained`` stacks one such
+tree per tenant into the engine's serving bank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from progen_tpu.core.precision import Policy, make_policy
+from progen_tpu.models.progen import ProGen, ProGenConfig
+from progen_tpu.train.step import TrainFunctions, make_train_functions
+from progen_tpu.workloads.lora import lora_sites
+
+ADAPTER_LABEL = "adapters"
+FROZEN_LABEL = "frozen"
+
+
+class LoRAProGen(nn.Module):
+    """ProGen with trainable low-rank adapters and a frozen base.
+
+    The base model lives as the submodule ``"base"`` (so its param subtree is
+    ``params["base"]`` — byte-compatible with a pretrained ProGen checkpoint,
+    see :func:`init_from_base`).  Each adapter site contributes two wrapper
+    params ``{layer}_{site}_a`` / ``{layer}_{site}_b``; ``b`` starts zero so
+    step 0 is the base model exactly (standard LoRA init).
+
+    The forward stacks ``[zeros, factors]`` into a 2-tenant bank and runs
+    every row as tenant 1 — the identical gather/einsum/where graph the
+    serving engine executes, with gradients flowing into row 1 only.
+    """
+
+    config: ProGenConfig
+    rank: int
+    policy: Policy = dataclasses.field(default_factory=make_policy)
+    remat: bool = False
+    remat_policy: str = "full"
+    attn_impl: str = "xla"
+    sgu_impl: str = "xla"
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        adapters = {}
+        for layer, s in sorted(lora_sites(self.config).items()):
+            bank = {}
+            for name, (din, dout) in sorted(s.items()):
+                # adapters are tiny (rank << dim): replicate, never shard
+                a = self.param(
+                    f"{layer}_{name}_a",
+                    nn.with_logical_partitioning(
+                        nn.initializers.lecun_normal(), (None, None)
+                    ),
+                    (din, self.rank),
+                    self.policy.param_dtype,
+                )
+                b = self.param(
+                    f"{layer}_{name}_b",
+                    nn.with_logical_partitioning(
+                        nn.initializers.zeros, (None, None)
+                    ),
+                    (self.rank, dout),
+                    self.policy.param_dtype,
+                )
+                bank[name] = {
+                    "a": jnp.stack([jnp.zeros_like(a), a]),
+                    "b": jnp.stack([jnp.zeros_like(b), b]),
+                }
+            adapters[layer] = bank
+        tenant = jnp.ones((tokens.shape[0],), jnp.int32)
+        base = ProGen(
+            config=self.config,
+            policy=self.policy,
+            remat=self.remat,
+            remat_policy=self.remat_policy,
+            attn_impl=self.attn_impl,
+            sgu_impl=self.sgu_impl,
+            mesh=self.mesh,
+            name="base",
+        )
+        return base(tokens, adapters, tenant)
+
+
+def lora_param_labels(params) -> dict:
+    """Label pytree for ``optax.multi_transform``: the ``"base"`` subtree is
+    :data:`FROZEN_LABEL`, every wrapper factor is :data:`ADAPTER_LABEL`."""
+    return {
+        k: jax.tree.map(
+            lambda _: FROZEN_LABEL if k == "base" else ADAPTER_LABEL, v
+        )
+        for k, v in params.items()
+    }
+
+
+def make_lora_optimizer(
+    learning_rate=1e-3,
+    *,
+    grad_accum_every: int = 1,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Adapter-only optimizer: adamw on the factors, ``set_to_zero`` on the
+    base (grads for the frozen subtree are computed then discarded — the
+    wasted elementwise work is noise next to the fwd+bwd, and keeping one
+    ``value_and_grad`` over the whole tree keeps ``make_train_functions``
+    untouched).  Wrapped in ``optax.MultiSteps`` when accumulating, matching
+    the ``make_train_functions`` contract."""
+    tx = optax.multi_transform(
+        {
+            ADAPTER_LABEL: optax.adamw(
+                learning_rate, b1=b1, b2=b2, weight_decay=weight_decay
+            ),
+            FROZEN_LABEL: optax.set_to_zero(),
+        },
+        lora_param_labels,
+    )
+    if grad_accum_every > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=int(grad_accum_every))
+    return tx
+
+
+def lora_train_functions(
+    model: LoRAProGen,
+    sample_tokens,
+    learning_rate=1e-3,
+    mesh: Mesh | None = None,
+    strategies=("dp",),
+    grad_accum_every: int = 1,
+    weight_decay: float = 0.0,
+) -> TrainFunctions:
+    """The standard :func:`make_train_functions` bundle (incl. the fused
+    ``train_multi_step`` superstep path) with the frozen-base optimizer."""
+    tx = make_lora_optimizer(
+        learning_rate,
+        grad_accum_every=grad_accum_every,
+        weight_decay=weight_decay,
+    )
+    return make_train_functions(
+        model,
+        tx,
+        sample_tokens,
+        mesh=mesh,
+        strategies=strategies,
+        grad_accum_every=grad_accum_every,
+        lr_schedule=learning_rate,
+    )
+
+
+def init_from_base(params: dict, base_params: dict) -> dict:
+    """Overwrite the wrapper's ``"base"`` subtree with pretrained ProGen
+    params (e.g. a serving checkpoint).  Shapes must match; dtypes are cast
+    leaf-wise so an f32 checkpoint drops into a bf16-param policy cleanly."""
+    if "base" not in params:
+        raise ValueError("params has no 'base' subtree — not LoRAProGen params")
+    cast = jax.tree.map(
+        lambda old, new: jnp.asarray(new, old.dtype),
+        params["base"],
+        nn.meta.unbox(base_params),
+    )
+    out = dict(params)
+    out["base"] = cast
+    return out
+
+
+def extract_adapters(params: dict, config: ProGenConfig) -> dict:
+    """Trained factor tree ``{layer: {site: {"a": (din, r), "b": (r, dout)}}}``
+    — the per-tenant element ``workloads/lora.bank_from_trained`` stacks into
+    a serving bank."""
+    out: dict = {}
+    for layer, s in sorted(lora_sites(config).items()):
+        out[layer] = {}
+        for name in sorted(s):
+            out[layer][name] = {
+                "a": jnp.asarray(params[f"{layer}_{name}_a"], jnp.float32),
+                "b": jnp.asarray(params[f"{layer}_{name}_b"], jnp.float32),
+            }
+    return out

@@ -396,11 +396,18 @@ class ServingMemoryPlan:
     # disaggregated serving: the bounded handoff queue can hold up to
     # ``handoff_depth`` full (num_slots, ...)-shaped handles in flight
     handoff_bytes: int = 0
+    # constrained infilling: the slot-resident (max_len, vocab) bool logit
+    # mask — allocated for every slot regardless of workload mix, since the
+    # engine keeps the mask in state unconditionally (all-pass when unused)
+    lmask_bytes_per_slot: int = 0
+    # multi-tenant LoRA: the stacked (T, din, r)/(T, r, dout) adapter bank,
+    # one copy shared by all slots
+    adapter_bytes: int = 0
 
     @property
     def fixed_bytes_per_slot(self) -> int:
         return (self.ring_bytes_per_slot + self.carry_bytes_per_slot
-                + self.seq_bytes_per_slot)
+                + self.seq_bytes_per_slot + self.lmask_bytes_per_slot)
 
     @property
     def pageable_bytes(self) -> int:
@@ -413,7 +420,7 @@ class ServingMemoryPlan:
                                   + self.gate_bytes_per_slot
                                   + self.draft_bytes_per_slot)
                 + self.pool_bytes + self.table_bytes
-                + self.handoff_bytes)
+                + self.handoff_bytes + self.adapter_bytes)
 
 
 def gate_row_bytes(cfg, mixed_precision: bool = True) -> int:
@@ -429,7 +436,8 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
                  mixed_precision: bool = True, paged: bool = False,
                  page_size: int = 16, num_pages: int | None = None,
                  draft_cfg=None, disagg: bool = False,
-                 handoff_depth: int = 2) -> ServingMemoryPlan:
+                 handoff_depth: int = 2, lora_tenants: int = 0,
+                 lora_rank: int = 0) -> ServingMemoryPlan:
     """HBM accounting for a ServingEngine configuration (dense or paged).
 
     Mirrors ``decode/engine.py``'s state layout: k/v rings + carries +
@@ -444,13 +452,19 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
     case: ``handoff_depth`` handles, each a full ``(num_slots, ...)``
     state copy with dense gate slabs (even in paged mode — the worker
     hands off dense rows and the merge scatters them into the pool), plus
-    the draft caches when both modes are on."""
+    the draft caches when both modes are on.
+
+    The per-slot ``(max_len, vocab)`` bool logit mask (constrained
+    infilling) is counted unconditionally — the engine allocates it for
+    every configuration.  ``lora_tenants``/``lora_rank`` add the stacked
+    adapter bank (one copy, all slots share it)."""
     act = 2 if mixed_precision else 4
     L = min(max_len or cfg.seq_len, cfg.seq_len)
     ring = 2 * cfg.window_size
     ring_b = cfg.depth * 2 * cfg.heads * ring * cfg.dim_head * act
     carry_b = cfg.depth * 2 * cfg.dim * act
     seq_b = L * 4
+    lmask_b = L * cfg.num_tokens  # bool, 1 byte per (position, vocab) cell
     row_b = gate_row_bytes(cfg, mixed_precision)
     pages_per_row = -(-L // page_size)
     if paged:
@@ -472,10 +486,16 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
                    + L * gate_row_bytes(draft_cfg, mixed_precision))
     handoff_b = 0
     if disagg:
-        # a handle row always carries the DENSE gate slab; ~40 B of
-        # per-row scalars (pos/start/stop/done/keys/knobs) ride along
-        per_row = ring_b + carry_b + seq_b + L * row_b + draft_b + 40
+        # a handle row always carries the DENSE gate slab and the logit
+        # mask; ~40 B of per-row scalars (pos/start/stop/done/keys/knobs)
+        # ride along
+        per_row = (ring_b + carry_b + seq_b + lmask_b + L * row_b
+                   + draft_b + 40)
         handoff_b = handoff_depth * num_slots * per_row
+    adapter_b = 0
+    if lora_tenants:
+        from progen_tpu.workloads.lora import adapter_bank_bytes
+        adapter_b = adapter_bank_bytes(cfg, lora_tenants, lora_rank)
     return ServingMemoryPlan(
         ring_bytes_per_slot=ring_b,
         carry_bytes_per_slot=carry_b,
@@ -486,6 +506,8 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
         num_slots=num_slots,
         draft_bytes_per_slot=draft_b,
         handoff_bytes=handoff_b,
+        lmask_bytes_per_slot=lmask_b,
+        adapter_bytes=adapter_b,
     )
 
 

@@ -1,0 +1,49 @@
+"""First-class served workloads beyond left-to-right generation.
+
+Three request classes ride the existing engine/cluster/trainer:
+
+* **constrained span-infilling** (:mod:`.infill`) — :class:`ScaffoldSpec`
+  compiles a protein scaffold template (frozen positions, per-position
+  allowed alphabets) into the per-request ``(G, V)`` logit mask the
+  engine threads through every sampling site;
+* **embeddings** (:func:`progen_tpu.decode.prefill.make_embedder`,
+  re-exported here) — one prefill-shaped forward, mean-pooled final
+  hidden states, no decode slots consumed;
+* **multi-tenant batched LoRA** (:mod:`.lora`) — stacked per-tenant
+  low-rank adapter banks gathered per slot inside the decode step.
+
+``WORKLOADS`` names the request classes the router/bench understand.
+"""
+
+from progen_tpu.decode.prefill import make_embedder
+from progen_tpu.workloads.infill import (
+    ScaffoldSpec,
+    mask_from_wire,
+    mask_to_wire,
+)
+from progen_tpu.workloads.lora import (
+    adapter_bank_bytes,
+    bank_from_trained,
+    bank_num_tenants,
+    init_lora_bank,
+    lora_sites,
+    random_lora_bank,
+    validate_lora_bank,
+)
+
+WORKLOADS = ("generate", "infill", "embed", "lora")
+
+__all__ = [
+    "WORKLOADS",
+    "ScaffoldSpec",
+    "adapter_bank_bytes",
+    "bank_from_trained",
+    "bank_num_tenants",
+    "init_lora_bank",
+    "lora_sites",
+    "make_embedder",
+    "mask_from_wire",
+    "mask_to_wire",
+    "random_lora_bank",
+    "validate_lora_bank",
+]

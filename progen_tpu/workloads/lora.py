@@ -1,0 +1,167 @@
+"""Multi-tenant LoRA adapter banks for serving.
+
+An adapter SITE is one dense projection in the model: the fused q/k/v
+projection and the attention output projection of every layer, plus the
+SGU channel projection of every gMLP layer.  A serving BANK stacks the
+low-rank factors of ``T`` tenants per site::
+
+    bank[f"attn{i}"]["qkv"] = {"a": (T, dim, r),   "b": (T, r, 3*inner)}
+    bank[f"attn{i}"]["out"] = {"a": (T, inner, r), "b": (T, r, dim)}
+    bank[f"ff{i}"]["sgu"]   = {"a": (T, half, r),  "b": (T, r, half)}
+
+Tenant 0 is the BASE model: its factor rows are all-zero by construction
+and the model applies the delta through an output-side ``where`` guard
+(``models/progen.apply_lora``), so a tenant-0 slot is bit-identical to
+running without adapters at all.  At decode time each batch row gathers
+its own tenant's factors (``models/progen.lora_delta``) — one program
+serves every tenant in the batch.
+
+Any LoRA alpha/scale is folded into ``b`` when the bank is built
+(:func:`bank_from_trained`); serving never sees a scale knob.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from progen_tpu.models.progen import ProGenConfig
+
+
+def lora_sites(config: ProGenConfig) -> dict[str, dict[str, tuple[int, int]]]:
+    """``{layer: {site: (d_in, d_out)}}`` for every adapter site."""
+    inner = config.heads * config.dim_head
+    sites: dict[str, dict[str, tuple[int, int]]] = {}
+    for i in range(config.depth):
+        sites[f"attn{i}"] = {
+            "qkv": (config.dim, 3 * inner),
+            "out": (inner, config.dim),
+        }
+    for i in range(config.depth):
+        if config.layer_uses_gmlp(i):
+            # gMLP layers run non-GLU, so hidden = dim * ff_mult and the
+            # SGU channel projection maps half -> half
+            half = (config.dim * config.ff_mult) // 2
+            sites[f"ff{i}"] = {"sgu": (half, half)}
+    return sites
+
+
+def init_lora_bank(config: ProGenConfig, num_tenants: int, rank: int,
+                   seed: int = 0) -> dict:
+    """Fresh serving bank: ``a`` rows lecun-normal per tenant, ``b`` rows
+    zero (standard LoRA init — every tenant starts as an exact no-op),
+    tenant 0 all-zero."""
+    if num_tenants < 1:
+        raise ValueError("num_tenants must be >= 1 (tenant 0 is the base)")
+    key = jax.random.key(seed)
+    bank: dict = {}
+    for layer, s in sorted(lora_sites(config).items()):
+        bank[layer] = {}
+        for name, (din, dout) in sorted(s.items()):
+            key, sub = jax.random.split(key)
+            a = jax.random.normal(sub, (num_tenants, din, rank),
+                                  jnp.float32) * (din ** -0.5)
+            a = a.at[0].set(0.0)
+            bank[layer][name] = {
+                "a": a,
+                "b": jnp.zeros((num_tenants, rank, dout), jnp.float32),
+            }
+    return bank
+
+
+def random_lora_bank(config: ProGenConfig, num_tenants: int, rank: int,
+                     seed: int = 0, scale: float = 1e-2) -> dict:
+    """A bank whose non-base tenants produce NONZERO deltas (both factors
+    random) — test fixtures and bench load need tenants that visibly
+    diverge from the base model.  Tenant 0 stays all-zero."""
+    bank = init_lora_bank(config, num_tenants, rank, seed=seed)
+    key = jax.random.key(seed + 1)
+    for layer in sorted(bank):
+        for name in sorted(bank[layer]):
+            key, sub = jax.random.split(key)
+            site = bank[layer][name]
+            b = jax.random.normal(sub, site["b"].shape, jnp.float32) * scale
+            site["b"] = b.at[0].set(0.0)
+    return bank
+
+
+def bank_num_tenants(bank: dict) -> int:
+    for layer in bank.values():
+        for site in layer.values():
+            return int(site["a"].shape[0])
+    raise ValueError("empty adapter bank")
+
+
+def validate_lora_bank(config: ProGenConfig, bank: dict) -> int:
+    """Shape-check a bank against the model's sites; returns ``T``."""
+    sites = lora_sites(config)
+    if set(bank) != set(sites):
+        raise ValueError(
+            f"bank layers {sorted(bank)} != model sites {sorted(sites)}")
+    t = bank_num_tenants(bank)
+    r = None
+    for layer, s in sites.items():
+        if set(bank[layer]) != set(s):
+            raise ValueError(
+                f"bank[{layer!r}] sites {sorted(bank[layer])} != "
+                f"{sorted(s)}")
+        for name, (din, dout) in s.items():
+            a = bank[layer][name]["a"]
+            b = bank[layer][name]["b"]
+            if r is None:
+                r = a.shape[-1]
+            want_a = (t, din, r)
+            want_b = (t, r, dout)
+            if tuple(a.shape) != want_a or tuple(b.shape) != want_b:
+                raise ValueError(
+                    f"bank[{layer!r}][{name!r}] shapes a={tuple(a.shape)} "
+                    f"b={tuple(b.shape)}, want a={want_a} b={want_b}")
+    return t
+
+
+def bank_from_trained(config: ProGenConfig, rank: int, trained: list,
+                      scale: float = 1.0) -> dict:
+    """Build a serving bank from per-tenant TRAINED adapter trees.
+
+    ``trained[t]`` holds tenant ``t + 1``'s factors as
+    ``{layer: {site: {"a": (din, r), "b": (r, dout)}}}`` (what
+    ``train/lora.py``'s ``extract_adapters`` returns).  Tenant 0 is the
+    all-zero base row; ``scale`` (e.g. alpha / rank) is folded into
+    ``b`` so serving needs no scale knob.
+    """
+    sites = lora_sites(config)
+    num_tenants = len(trained) + 1
+    bank: dict = {}
+    for layer, s in sorted(sites.items()):
+        bank[layer] = {}
+        for name, (din, dout) in sorted(s.items()):
+            a_rows = [jnp.zeros((din, rank), jnp.float32)]
+            b_rows = [jnp.zeros((rank, dout), jnp.float32)]
+            for tree in trained:
+                site = tree[layer][name]
+                a_rows.append(jnp.asarray(site["a"], jnp.float32))
+                b_rows.append(jnp.asarray(site["b"], jnp.float32) * scale)
+            bank[layer][name] = {
+                "a": jnp.stack(a_rows),
+                "b": jnp.stack(b_rows),
+            }
+    validate_lora_bank(config, bank)
+    assert bank_num_tenants(bank) == num_tenants
+    return bank
+
+
+def adapter_bank_bytes(config: ProGenConfig, num_tenants: int, rank: int,
+                       bytes_per_el: int = 4) -> int:
+    """HBM footprint of a serving bank (f32 by default) — the memory
+    plan's adapter line item."""
+    total = 0
+    for s in lora_sites(config).values():
+        for din, dout in s.values():
+            total += num_tenants * rank * (din + dout) * bytes_per_el
+    return total
+
+
+def tenant_ids(bank: dict) -> np.ndarray:
+    """The usable non-base tenant ids for a bank: ``1..T-1``."""
+    return np.arange(1, bank_num_tenants(bank))

@@ -35,6 +35,18 @@ honor_env_platforms()
                    "'|', or --num_samples copies) become queued requests, "
                    "prefilled in one parallel forward and decoded in early-"
                    "exit chunks (docs/SERVING.md)")
+@click.option("--embed", "embed_mode", is_flag=True,
+              help="with --serve: embeddings workload — one-pass prefill "
+                   "forward per prime, mean-pooled final-layer hidden "
+                   "state; prints the (D,) vector stats instead of decoded "
+                   "tokens (docs/SERVING.md §8)")
+@click.option("--infill", default=None, metavar="TEMPLATE",
+              help="with --serve: constrained span-infilling — plain "
+                   "characters are frozen scaffold positions, '?' a free "
+                   "design position, '[ILV]' a position restricted to that "
+                   "set; the engine decodes under the scaffold's per-"
+                   "position logit mask so constrained positions can ONLY "
+                   "emit allowed tokens (docs/SERVING.md §8)")
 @click.option("--slots", default=8, help="engine: max concurrent requests")
 @click.option("--chunk", default=32, help="engine: decode steps per device "
                                           "program between refill points")
@@ -103,8 +115,8 @@ honor_env_platforms()
                    "disables); overrides PROGEN_COMPILE_CACHE, default "
                    "~/.cache/progen_tpu/xla")
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
-         seq_len, mesh_spec, strategies, serve, slots, chunk, paged,
-         page_size, serve_attempts, snapshot_path, aot_warmup,
+         seq_len, mesh_spec, strategies, serve, embed_mode, infill, slots,
+         chunk, paged, page_size, serve_attempts, snapshot_path, aot_warmup,
          spec, spec_k, disagg, serve_procs, prefill_procs, replicas,
          watchdog_timeout, trace, trace_out, xprof_dir, compile_cache):
     import os
@@ -177,17 +189,61 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
     print(f"sequence length: {seq_len}")
     print(f"trained for {max(meta['next_seq_index'], 0)} sequences")
 
+    if (embed_mode or infill) and not serve:
+        raise click.BadParameter(
+            "--embed/--infill are serving workloads; add --serve",
+            param_hint="--serve")
+    if embed_mode and infill:
+        raise click.BadParameter("pick ONE of --embed / --infill",
+                                 param_hint="--embed")
+
     if serve:
         from progen_tpu.decode import Request, ServingEngine, run_with_restarts
         from progen_tpu.resilience import Watchdog
 
         primes = prime.split("|") if "|" in prime else [prime] * num_samples
         requests = []
-        for i, p in enumerate(primes):
-            toks = [0] + encode_tokens(p)  # BOS-prefixed, like add_bos
-            requests.append(Request(
-                uid=i, tokens=toks, max_new_tokens=seq_len - len(toks),
-                top_k=top_k, temperature=temperature, seed=seed + i))
+        if infill is not None:
+            from progen_tpu.workloads import ScaffoldSpec
+
+            def template_entry(seg):
+                if seg == "?":
+                    return None
+                if len(seg) > 1:  # bracket set [ILV]
+                    return tuple(encode_tokens(c)[0] for c in seg)
+                return encode_tokens(seg)[0]
+
+            segs, i = [], 0
+            while i < len(infill):
+                if infill[i] == "[":
+                    j = infill.index("]", i)
+                    segs.append(infill[i + 1:j])
+                    i = j + 1
+                else:
+                    segs.append(infill[i])
+                    i += 1
+            scaffold = ScaffoldSpec(
+                template=[0] + [template_entry(s) for s in segs],
+                vocab=model_config.num_tokens)
+            primes = [infill] * num_samples
+            kw = scaffold.request_kwargs()
+            requests = [Request(uid=i, top_k=top_k, temperature=temperature,
+                                seed=seed + i, workload="infill", **kw)
+                        for i in range(num_samples)]
+        else:
+            for i, p in enumerate(primes):
+                toks = [0] + encode_tokens(p)  # BOS-prefixed, like add_bos
+                requests.append(Request(
+                    uid=i, tokens=toks, max_new_tokens=seq_len - len(toks),
+                    top_k=top_k, temperature=temperature, seed=seed + i,
+                    workload="embed" if embed_mode else "generate"))
+
+        def print_embedding(comp):
+            v = np.asarray(comp.embedding)
+            print(f"\n {primes[comp.uid]} \n", "*" * 40,
+                  f"[embed, dim={v.shape[0]}, "
+                  f"norm={float(np.linalg.norm(v)):.4f}, "
+                  f"{comp.latency:.2f}s]\n", np.round(v[:8], 4).tolist())
 
         if serve_procs:
             if mesh_spec is not None:
@@ -212,7 +268,10 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
             try:
                 with profile_trace(xprof_dir):
                     for r in requests:
-                        cluster.submit(r)
+                        if embed_mode:
+                            cluster.submit_embed(r)
+                        else:
+                            cluster.submit(r)
                     completions = cluster.drain()
             finally:
                 cluster.shutdown()
@@ -221,6 +280,9 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                 if merged:
                     print(f"trace: {merged}")
             for comp in sorted(completions, key=lambda c: c.uid):
+                if comp.embedding is not None:
+                    print_embedding(comp)
+                    continue
                 print(f"\n {primes[comp.uid]} \n", "*" * 40,
                       f"[{comp.finish_reason}, {len(comp.tokens)} tokens, "
                       f"{comp.latency:.2f}s]\n", decode_tokens(comp.tokens))
@@ -241,16 +303,22 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                 mesh=mesh, strategies=strategy_list,
                 params_shardings=param_sh, watchdog=watchdog)
             if aot_warmup:
-                stats = eng.aot_warmup()
+                stats = eng.aot_warmup(embed=embed_mode)
                 print(f"aot warmup: {stats['programs']} programs in "
                       f"{stats['seconds']:.1f}s")
             return eng
 
         try:
             with profile_trace(xprof_dir):
-                completions = run_with_restarts(
-                    engine_factory, requests, attempts=serve_attempts,
-                    snapshot_path=snapshot_path)
+                if embed_mode:
+                    eng = engine_factory()
+                    for r in requests:
+                        eng.submit_embed(r)
+                    completions = eng.run_until_idle()
+                else:
+                    completions = run_with_restarts(
+                        engine_factory, requests, attempts=serve_attempts,
+                        snapshot_path=snapshot_path)
         finally:
             if watchdog is not None:
                 watchdog.stop()
@@ -260,6 +328,9 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
             if merged:
                 print(f"trace: {merged}")
         for comp in sorted(completions, key=lambda c: c.uid):
+            if comp.embedding is not None:
+                print_embedding(comp)
+                continue
             print(f"\n {primes[comp.uid]} \n", "*" * 40,
                   f"[{comp.finish_reason}, {len(comp.tokens)} tokens, "
                   f"{comp.latency:.2f}s]\n", decode_tokens(comp.tokens))

@@ -4,12 +4,21 @@ Runs ONE process of a 2-process ``jax.distributed`` CPU job executing the
 real Trainer.  Spawned by ``tests/test_multihost.py`` — not a test module
 itself (leading underscore keeps pytest collection away).
 
-argv: process_id num_processes port data_dir ckpt_dir runs_dir [strategy]
+argv: process_id num_processes port data_dir ckpt_dir runs_dir
+      [strategy [superstep [batch_size]]]
 
-``strategy`` (default ``dp``): ``dp`` maps the 2-process mesh onto the
+``strategy`` (default ``dp``): ``dp`` maps the 2-device mesh onto the
 data axis (params replicated); ``fsdp`` onto the fsdp axis (params,
 grads AND optimizer state sharded across the two processes — the
 cooperative orbax save then writes genuinely distributed arrays).
+
+``superstep`` (default 1): when > 1 the Trainer runs the fused
+``train_multi_step`` loop and each process stages only its own shard of
+the (K, accum, batch, seq) superbatch.  ``log_every`` is set to the
+superstep so spans can actually fuse (``superstep_span`` never crosses a
+log boundary).  ``batch_size`` (default 2) is the PER-HOST batch: the
+test's single-process reference leg passes 4 to keep the global batch at
+4 rows either way.
 """
 
 import json
@@ -22,17 +31,25 @@ def main() -> None:
     )
     data_dir, ckpt_dir, runs_dir = sys.argv[4], sys.argv[5], sys.argv[6]
     strategy = sys.argv[7] if len(sys.argv) > 7 else "dp"
+    superstep = int(sys.argv[8]) if len(sys.argv) > 8 else 1
+    batch_size = int(sys.argv[9]) if len(sys.argv) > 9 else 2
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # cross-process computations on the CPU backend need a collectives
+    # implementation — the default ("none") hard-fails the first psum
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=f"localhost:{port}",
         num_processes=num_processes,
         process_id=process_id,
     )
     assert jax.process_count() == num_processes
-    assert jax.local_device_count() == 1  # XLA flag set by the test
+    # the mesh always spans two devices total: two processes with one
+    # device each, or one process exposing two (XLA flag set by the test)
+    ndev = jax.device_count()
+    assert ndev == 2 and jax.local_device_count() == 2 // num_processes
 
     from progen_tpu.core.mesh import MeshConfig
     from progen_tpu.models import ProGenConfig
@@ -45,17 +62,18 @@ def main() -> None:
     )
     cfg = TrainerConfig(
         seed=7,
-        batch_size=2,               # per-host -> global batch 4
+        batch_size=batch_size,      # per-host -> global batch 4
         grad_accum_every=1,
         epochs=1,
         mixed_precision=False,      # f32 so losses compare tightly
         strategies=(strategy,),
         mesh=(
-            MeshConfig(data=num_processes, fsdp=1, tensor=1, seq=1)
+            MeshConfig(data=ndev, fsdp=1, tensor=1, seq=1)
             if strategy == "dp"
-            else MeshConfig(data=1, fsdp=num_processes, tensor=1, seq=1)
+            else MeshConfig(data=1, fsdp=ndev, tensor=1, seq=1)
         ),
-        log_every=1,
+        superstep=superstep,
+        log_every=superstep,
         validate_every=2,
         sample_every=3,             # exercise SPMD in-training sampling
         prime_length=8,

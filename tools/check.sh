@@ -70,6 +70,18 @@ JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
     --serve-procs --trace --trace-out "$TRACE_DIR"
 python tools/traceview.py --summarize "$TRACE_DIR/trace.json"
 
+echo "== scenario-mix smoke =="
+# all four workload classes (generate / constrained infill / embeddings /
+# multi-tenant LoRA) through ONE engine run with --verify: asserts rerun
+# identity (tokens AND embedding bytes), that constrained positions never
+# emit a masked token, that tenant-0 rows match a bankless engine, and
+# that snapshot -> restore -> replay reproduces the run (docs/SERVING.md §8)
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 8 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --scenario-mix "generate=0.4,infill=0.2,embed=0.2,lora=0.2" \
+    --lora-tenants 4 --lora-rank 4 --verify
+
 echo "== superstep quick-bench smoke =="
 # tiny-shape K-sweep on CPU: proves the fused dispatch path runs end to
 # end and emits parseable JSON (full sweep: benchmarks/superstep.md)
