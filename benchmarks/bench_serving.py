@@ -81,6 +81,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from progen_tpu.observe import slo as _slo
 from progen_tpu.observe.meter import profile_trace
 from progen_tpu.observe.metrics import latency_percentiles
 from progen_tpu.observe.platform import probe_backend, stamp_record
@@ -195,7 +196,18 @@ def main() -> None:
                     default="reject")
     ap.add_argument("--slo", type=float, default=10.0,
                     help="latency SLO in seconds for the within_slo_frac "
-                         "metric (over OK completions)")
+                         "metric (over OK completions) — evaluated by "
+                         "observe/slo.py, the same code path the live "
+                         "fleet's burn rates use")
+    ap.add_argument("--slo-target", type=float, default=0.95,
+                    help="objective fraction of requests within --slo; "
+                         "the record's slo_burn_rate is the error-budget "
+                         "burn against this target")
+    ap.add_argument("--statusz", action="store_true",
+                    help="with --serve-procs: start the live introspection "
+                         "plane in every process and self-check /healthz "
+                         "+ /metricsz from driver and workers mid-run "
+                         "(the check.sh statusz smoke)")
     ap.add_argument("--aot-warmup", action="store_true",
                     help="warm up via AOT lower().compile() over the "
                          "(prefill bucket, chunk) grid instead of two "
@@ -555,15 +567,20 @@ def main() -> None:
             "pause_events": engine.pause_events,
         })
     if args.chaos:
+        # one SLO code path: the same bucket math the live fleet's
+        # /statusz burn rates run (observe/slo.py)
+        frac = (_slo.frac_within_values((c.latency for c in ok), args.slo)
+                if ok else 0.0)
+        burn = _slo.burn_rate(frac, args.slo_target)
         record.update({
             "faults_plan": args.faults,
             "faults_seed": args.faults_seed,
             "slo_s": args.slo,
+            "slo_target": args.slo_target,
             "ok_requests": len(ok),
             "goodput_tokens_per_sec": record.pop("tokens_per_sec"),
-            "within_slo_frac": round(
-                sum(1 for c in ok if c.latency <= args.slo)
-                / max(1, len(ok)), 3),
+            "within_slo_frac": round(frac, 3),
+            "slo_burn_rate": round(burn, 4),
             "robustness": counters,
         })
 
@@ -586,6 +603,68 @@ def main() -> None:
     if args.out:
         with open(args.out, "a") as f:
             f.write(line + "\n")
+
+
+_PROM_LINE = None  # compiled lazily in _assert_prometheus
+
+
+def _assert_prometheus(text: str) -> int:
+    """Strict line-format check of a /metricsz body: every line is a
+    ``# TYPE``/comment line or ``name{labels} value``.  Returns the
+    sample count (must be > 0)."""
+    import re
+
+    global _PROM_LINE
+    if _PROM_LINE is None:
+        _PROM_LINE = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+            r' (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$')
+    samples = 0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        samples += 1
+    assert samples > 0, "empty /metricsz exposition"
+    return samples
+
+
+def _check_statusz(cluster) -> dict:
+    """Fetch /healthz + /metricsz from the DRIVER and EVERY worker while
+    the cluster is live; assert 200 and parseable bodies.  This is the
+    in-process half of the check.sh statusz smoke."""
+    import urllib.request
+
+    ports = cluster.stats().get("statusz_ports", {})
+    assert "driver" in ports, f"no driver statusz port in {ports}"
+    want = 1 + cluster.prefill_procs + cluster.replicas
+    assert len(ports) == want, f"expected {want} statusz ports, got {ports}"
+    out = {}
+    for who, port in sorted(ports.items()):
+        for ep in ("/healthz", "/metricsz"):
+            body = status = None
+            for attempt in range(5):  # a racy host-dict read 503s; retry
+                try:
+                    resp = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{ep}", timeout=10)
+                    status = resp.status
+                    body = resp.read().decode()
+                    if status == 200:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.2)
+            assert status == 200, f"{who}{ep} -> {status}"
+            if ep == "/healthz":
+                health = json.loads(body)
+                assert health.get("status") == "ok", f"{who}: {health}"
+            else:
+                out[who] = _assert_prometheus(body)
+        print(f"statusz[{who}] OK on :{port} "
+              f"({out[who]} samples)", file=sys.stderr)
+    return out
 
 
 def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
@@ -619,6 +698,7 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
     # comparison engines' — token identity is assertable
     wspec = make_spec(cfg, mixed_precision=True, init_seed=0,
                       engine=engine_kw, draft_config=draft_config,
+                      statusz=args.statusz,
                       trace=({"dir": os.path.abspath(args.trace_out)}
                              if args.trace else None))
 
@@ -637,6 +717,10 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
                     seed=args.seed, submit_time=time.perf_counter()))
             cluster.drain(timeout=600.0)
             cluster.poll(0.0)  # discard the warm completions
+            if args.statusz:
+                # live-endpoint smoke while every process is up and warm:
+                # the measured drive below then proves zero perturbation
+                _check_statusz(cluster)
 
             t0 = time.perf_counter()
             served: list = []
@@ -698,6 +782,10 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
         "tokens_per_sec": round(gen / wall, 1),
         "p50_latency_s": round(c50, 3),
         "p95_latency_s": round(c95, 3),
+        "slo_s": args.slo,
+        "within_slo_frac": round(
+            _slo.frac_within_values((c.latency for c in ok), args.slo)
+            if ok else 0.0, 3),
         # per-stage wall time per worker: decode replicas must report
         # prefill_s == 0.0 — the prefill wall left the process entirely
         "stage_seconds": {w: st.get("stage_seconds")

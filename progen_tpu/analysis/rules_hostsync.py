@@ -43,9 +43,14 @@ class Zone:
 HOT_ZONES: tuple[Zone, ...] = (
     Zone(
         r"train/trainer\.py$",
-        r"Trainer\.(_run_loop|_run_loop_superstep|evaluate|_note_phase)$",
+        r"Trainer\.(_run_loop|_run_loop_superstep|evaluate|_note_phase"
+        r"|_publish_train_health|_statusz_health|_statusz_status)$",
         frozenset({"meter", "tracker", "config", "model_config", "store",
-                   "_recorder", "_tracer", "lr_schedule"}),
+                   "_recorder", "_tracer", "lr_schedule", "cfg",
+                   "_watchdog", "_preempt_requested"}),
+        # the log dict holds host floats from the loop's one batched
+        # jax.device_get — publishing them is not a new sync
+        frozenset({"log"}),
     ),
     Zone(
         r"decode/engine\.py$",
@@ -58,7 +63,7 @@ HOT_ZONES: tuple[Zone, ...] = (
         r"|_admit_from_handoff|_prefill_worker_call|_merge_call"
         r"|admit_handle|run_prefill_round|drain_sheds|_note_stage"
         r"|submit_embed|_embed_round|run_embed_round|embed_pending"
-        r"|_build_lmask)$",
+        r"|_build_lmask|status)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
@@ -99,17 +104,36 @@ HOT_ZONES: tuple[Zone, ...] = (
     Zone(r"serve/cluster\.py$",
          r"ServeCluster\.(submit|_dispatch|_shed|poll|pending|drain"
          r"|_pump|_handle_event|_on_hello|_on_handle|_on_peer_dead"
-         r"|_return_credit|_check_stale|_note_clock)$",
+         r"|_return_credit|_check_stale|_note_clock|fleet_metrics"
+         r"|_statusz_health|_statusz_status)$",
          frozenset({"router", "completions", "supervisor", "counters",
                     "_new", "_events", "_peers", "_procs",
                     "_handled_dead", "_respawning", "_parked_uids",
                     "_worker_stats", "_hb", "_shutting_down",
                     "stale_after", "prefill_procs", "replicas",
                     "spec", "_tracer", "_lat", "_clock_offsets",
-                    "_stats_age"})),
+                    "_stats_age", "_statusz", "_statusz_ports",
+                    "_slo", "_slo_last", "_ok_ctr", "_shed_ctr"})),
     # span recording sits on every hot path above: it must never sync
     # (spans carry pre-computed floats, never device values)
     Zone(r"observe/trace\.py$", r"Tracer\.(span|add|event)$"),
+    # the introspection plane reads host snapshots only: any sync in a
+    # handler would break the zero-perturbation invariant (an enabled
+    # run must be token-identical to a disabled one)
+    Zone(r"observe/statusz\.py$",
+         r"(StatuszServer\.(_render|_call|_json)|render_prometheus"
+         r"|_fmt|_sample|_prom_name)$",
+         frozenset({"role", "index", "providers", "port"}),
+         # exposition inputs are JSON-safe host values by API contract
+         frozenset({"v", "value", "snapshot", "base", "labels", "extra"})),
+    Zone(r"observe/slo\.py$",
+         r"(BurnRateTracker\.(sample|evaluate)|SLOSpec\..*|evaluate"
+         r"|frac_within|frac_within_values|burn_rate|_diff_metric"
+         r"|_full_counts)$",
+         frozenset({"specs", "windows", "registry", "_samples"}),
+         # registry snapshots and their diffs are host floats by contract
+         frozenset({"snap", "snapshot", "new", "old", "values",
+                    "frac_good", "target", "threshold_s", "now", "p"})),
     Zone(r"observe/metrics\.py$",
          r"(Counter\.inc|Gauge\.set|Histogram\.observe)$"),
     Zone(r"train/step\.py$",

@@ -2255,6 +2255,31 @@ class ServingEngine:
                 (emitted / rounds) if rounds else 0.0,
         }
 
+    def status(self) -> dict:
+        """Live engine state for the /statusz endpoint — HOST bookkeeping
+        only (queues, slot maps, counters, stage walls).  This is served
+        from the statusz HTTP thread concurrently with the stage loop, so
+        it must never sync the device: ``spec_counters`` is deliberately
+        absent (it costs a ``jax.device_get``), and everything read here
+        is a plain host dict/int the GIL keeps coherent."""
+        active = len(self._inflight)
+        return {
+            "slots": {"total": self.num_slots, "active": active,
+                      "free": self.num_slots - active},
+            "queue_depth": len(self._queue),
+            "embed_queue_depth": len(self._embed_queue),
+            "pending_completions": len(self._pending),
+            "inflight_uids": sorted(r.uid for r in
+                                    list(self._inflight.values())),
+            "chunks_run": self.chunks_run,
+            "paged": self.paged,
+            "disagg": self.disagg,
+            "spec": self.spec,
+            "stage_seconds": {k: round(v, 6) for k, v in
+                              list(self.stage_seconds.items())},
+            "robust": self.robustness_counters(),
+        }
+
     def robustness_counters(self) -> dict:
         """Everything a chaos record needs: shed/containment tallies,
         faults fired by the armed plan, and (paged) pool pressure."""

@@ -13,11 +13,16 @@ from progen_tpu.observe.metrics import (
     Histogram,
     MetricsRegistry,
     get_registry,
+    labeled,
     latency_buckets,
     latency_percentiles,
+    merge_snapshots,
+    split_labeled,
 )
 from progen_tpu.observe.platform import emit_error_record, probe_backend
 from progen_tpu.observe.robustness import RobustnessCounters
+from progen_tpu.observe.slo import BurnRateTracker, SLOSpec
+from progen_tpu.observe.statusz import StatuszServer, render_prometheus
 from progen_tpu.observe.trace import (
     Tracer,
     chrome_trace,
@@ -56,6 +61,14 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_registry",
+    "labeled",
     "latency_buckets",
     "latency_percentiles",
+    "merge_snapshots",
+    "split_labeled",
+    # live introspection plane (observe.statusz / observe.slo)
+    "StatuszServer",
+    "render_prometheus",
+    "SLOSpec",
+    "BurnRateTracker",
 ]

@@ -20,9 +20,13 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "get_registry",
+    "labeled",
     "latency_buckets",
     "LATENCY_BUCKETS",
     "latency_percentiles",
+    "merge_snapshots",
+    "percentile_from_counts",
+    "split_labeled",
 ]
 
 
@@ -36,6 +40,29 @@ def latency_buckets(lo=1e-4, hi=100.0, n=64):
 
 
 LATENCY_BUCKETS = latency_buckets()
+
+
+def percentile_from_counts(bounds, counts, count, mn, mx, p):
+    """The one quantile walk: cumulative counts with linear interpolation
+    inside the target bucket, clamped to the observed min/max.  Shared by
+    live :class:`Histogram` objects and merged fleet snapshots (which only
+    have bucket counts, not raw values)."""
+    if count == 0:
+        return None
+    rank = (p / 100.0) * count
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        nxt = cum + c
+        if nxt >= rank:
+            lo = bounds[i - 1] if i > 0 else min(mn, 0.0)
+            hi = bounds[i] if i < len(bounds) else mx
+            frac = (rank - cum) / c
+            est = lo + (hi - lo) * frac
+            return min(max(est, mn), mx)
+        cum = nxt
+    return mx
 
 
 class Counter:
@@ -118,22 +145,8 @@ class Histogram:
 
     def percentile(self, p):
         """Estimate the p-th percentile (p in [0, 100])."""
-        if self.count == 0:
-            return None
-        rank = (p / 100.0) * self.count
-        cum = 0
-        for i, c in enumerate(self.counts):
-            if c == 0:
-                continue
-            nxt = cum + c
-            if nxt >= rank:
-                lo = self.bounds[i - 1] if i > 0 else min(self.min, 0.0)
-                hi = self.bounds[i] if i < len(self.bounds) else self.max
-                frac = (rank - cum) / c
-                est = lo + (hi - lo) * frac
-                return min(max(est, self.min), self.max)
-            cum = nxt
-        return self.max
+        return percentile_from_counts(self.bounds, self.counts, self.count,
+                                      self.min, self.max, p)
 
     def snapshot(self):
         snap = {"type": "histogram", "count": self.count,
@@ -144,6 +157,13 @@ class Histogram:
             snap["p50"] = self.percentile(50)
             snap["p95"] = self.percentile(95)
             snap["p99"] = self.percentile(99)
+            # sparse bucket counts ([index, count] pairs, JSON-safe) so
+            # fleet merges and Prometheus exposition can reconstruct the
+            # full distribution from a heartbeat snapshot
+            snap["buckets"] = [[i, c] for i, c in enumerate(self.counts)
+                               if c]
+        if self.bounds != LATENCY_BUCKETS:
+            snap["bounds"] = list(self.bounds)
         return snap
 
 
@@ -190,6 +210,83 @@ _REGISTRY = MetricsRegistry()
 def get_registry():
     """The process-wide metrics registry."""
     return _REGISTRY
+
+
+def labeled(name, **labels):
+    """Embed Prometheus-style labels in a metric name:
+    ``labeled("cluster.up", role="prefill", idx=0)`` ->
+    ``cluster.up{idx="0",role="prefill"}``.  Labels are sorted so the same
+    label set always produces the same registry key; values are escaped at
+    construction so :func:`split_labeled` and the exposition renderer can
+    pass them through verbatim."""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", r"\\")
+                         .replace('"', r'\"').replace("\n", r"\n"))
+        for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}" if inner else name
+
+
+def split_labeled(name):
+    """``name{a="b"}`` -> ``("name", 'a="b"')``; unlabeled -> ``(name, "")``."""
+    i = name.find("{")
+    if i < 0:
+        return name, ""
+    return name[:i], name[i + 1:].rstrip("}")
+
+
+def snapshot_bounds(snap):
+    """Bucket upper bounds a histogram snapshot was taken against (custom
+    bounds ride the snapshot; the default set is implied)."""
+    return tuple(snap.get("bounds", LATENCY_BUCKETS))
+
+
+def _merge_histogram(out, snap):
+    bounds = snapshot_bounds(snap)
+    if snapshot_bounds(out) != bounds:
+        raise ValueError(
+            f"cannot merge histogram snapshots with different bounds "
+            f"({len(snapshot_bounds(out))} vs {len(bounds)} buckets)")
+    counts = [0] * (len(bounds) + 1)
+    for i, c in out.get("buckets", ()):
+        counts[i] += c
+    for i, c in snap.get("buckets", ()):
+        counts[i] += c
+    out["count"] = out.get("count", 0) + snap.get("count", 0)
+    out["sum"] = round(out.get("sum", 0.0) + snap.get("sum", 0.0), 6)
+    if out["count"]:
+        out["min"] = min(out.get("min", math.inf),
+                         snap.get("min", math.inf))
+        out["max"] = max(out.get("max", -math.inf),
+                         snap.get("max", -math.inf))
+        out["buckets"] = [[i, c] for i, c in enumerate(counts) if c]
+        for p, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+            out[key] = percentile_from_counts(
+                bounds, counts, out["count"], out["min"], out["max"], p)
+    return out
+
+
+def merge_snapshots(snaps):
+    """Merge registry snapshots from several processes into one fleet
+    view: counters and gauges sum (fleet totals — per-process values that
+    must stay distinct use :func:`labeled` names, which never collide),
+    histograms merge bucket-for-bucket with percentiles recomputed from
+    the merged counts.  Type conflicts across processes raise."""
+    out = {}
+    for snap in snaps:
+        for name, m in snap.items():
+            cur = out.get(name)
+            if cur is None:
+                out[name] = dict(m)
+                continue
+            if cur.get("type") != m.get("type"):
+                raise ValueError(
+                    f"metric {name!r} is {cur.get('type')} in one process "
+                    f"and {m.get('type')} in another")
+            if m.get("type") == "histogram":
+                _merge_histogram(cur, m)
+            else:
+                cur["value"] = cur.get("value", 0) + m.get("value", 0)
+    return {name: out[name] for name in sorted(out)}
 
 
 def latency_percentiles(values, ps=(50.0, 95.0), name="bench.latency_s"):

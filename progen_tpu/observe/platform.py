@@ -29,19 +29,39 @@ import sys
 
 from progen_tpu.observe.gitinfo import git_sha
 
+# last wall_time stamped by this process — records within one process are
+# guaranteed strictly increasing even if the wall clock steps backwards
+# (NTP slew mid-benchmark), so tools/benchdiff.py can order same-sha
+# records by wall_time alone
+_last_wall = 0.0
+
 
 def stamp_record(record: dict | None = None, **extra) -> dict:
     """The one door every benchmark JSON record leaves through.
 
     Merges ``extra`` into a copy of ``record`` and guarantees the
-    ``git_sha`` stamp, so a record can always be traced back to the code
-    that produced it.  Callers pass their fields and never touch
+    ``git_sha`` and ``wall_time`` stamps, so a record can always be
+    traced back to the code that produced it and ordered against other
+    records of the same metric (``tools/benchdiff.py`` picks the latest
+    per file by ``wall_time``).  ``wall_time`` is monotonic-safe within
+    a process; callers on a traced path pass ``wall_time=...`` captured
+    outside the timed region rather than letting this function read the
+    clock.  Callers pass their fields and never touch
     :func:`~progen_tpu.observe.gitinfo.git_sha` directly —
     ``tests/test_observe.py`` sweeps the bench sources to keep it that
     way."""
+    global _last_wall
+    import time
+
     out = dict(record or {})
     out.update(extra)
     out.setdefault("git_sha", git_sha())
+    wall = out.get("wall_time")
+    if not isinstance(wall, (int, float)):
+        wall = time.time()
+    wall = max(float(wall), _last_wall + 1e-3) if _last_wall else float(wall)
+    _last_wall = wall
+    out["wall_time"] = round(wall, 3)
     return out
 
 
@@ -53,15 +73,13 @@ def emit_error_record(e: BaseException, **extra) -> None:
 
     import jax
 
-    print(json.dumps({
+    print(json.dumps(stamp_record({
         "error": f"{type(e).__name__}: {e}",
         "metric": None,
         "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
         "jax_version": jax.__version__,
         "python": platform.python_version(),
-        "git_sha": git_sha(),
-        **extra,
-    }), flush=True)
+    }, **extra)), flush=True)
 
 
 def probe_backend(**extra) -> bool:

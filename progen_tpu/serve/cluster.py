@@ -109,9 +109,37 @@ class ServeCluster:
         self._stats_age: dict = {}           # (role, idx) -> capture clock
         self._hb: dict = {}
         self._clock_offsets: dict = {}       # (role, idx) -> min offset (s)
+        self._statusz_ports: dict = {}       # (role, idx) -> loopback port
         self._tracer = _trace.get_tracer()
-        self._lat = _metrics.get_registry().histogram("cluster.latency_s")
+        registry = _metrics.get_registry()
+        self._lat = registry.histogram("cluster.latency_s")
+        # goodput accounting: served vs typed-shed completions — the two
+        # counters the ratio-kind SLO specs divide
+        self._ok_ctr = registry.counter("cluster.completions_ok")
+        self._shed_ctr = registry.counter("cluster.completions_shed")
         self._shutting_down = False
+        # live introspection plane (spec["statusz"]): the driver serves
+        # the FLEET view — per-worker registry snapshots (riding the
+        # heartbeat/stats frames already) merged bucket-for-bucket with
+        # its own registry, plus multi-window SLO burn rates
+        self._statusz = None
+        self._slo = None
+        self._slo_last = 0.0
+        if spec.get("statusz"):
+            from progen_tpu.observe.slo import BurnRateTracker, SLOSpec
+            from progen_tpu.observe.statusz import StatuszServer
+
+            self._slo = BurnRateTracker((
+                SLOSpec(name="latency_p95_2s", target=0.95,
+                        metric="cluster.latency_s", threshold_s=2.0),
+                SLOSpec(name="goodput", target=0.99, kind="ratio"),
+            ))
+            self._statusz = StatuszServer(
+                role="driver",
+                providers={"health": self._statusz_health,
+                           "status": self._statusz_status,
+                           "metrics": self.fleet_metrics})
+            self._statusz.start()
 
         self._tmp = tempfile.TemporaryDirectory(prefix="progen_serve_")
         self.log_dir = Path(log_dir) if log_dir else Path(self._tmp.name)
@@ -272,6 +300,7 @@ class ServeCluster:
         comp = _shed_completion(request, status, now)
         self.completions[uid] = comp
         self._new.append(comp)
+        self._shed_ctr.inc()
 
     def poll(self, timeout: float = 0.0) -> list[Completion]:
         """Process transport events for up to ``timeout`` seconds;
@@ -370,6 +399,10 @@ class ServeCluster:
                 # the one end-to-end latency code path: the same
                 # histogram bench_serving.py reads its p50/p95 from
                 self._lat.observe(now - submit if submit else 0.0)
+                if header.get("status", "ok") == "ok":
+                    self._ok_ctr.inc()
+                else:
+                    self._shed_ctr.inc()
                 self._tracer.event("cluster.done", trace=uid,
                                    latency_s=now - submit)
         elif t == "stats":
@@ -384,6 +417,13 @@ class ServeCluster:
         role, idx = header.get("role"), header.get("index", -1)
         peer.role, peer.index = role, idx
         self._peers[(role, idx)] = peer
+        if header.get("statusz_port"):
+            self._statusz_ports[(role, idx)] = header["statusz_port"]
+        # a dead-but-not-yet-restarted stage is visible here before the
+        # supervisor acts: up{role,idx} flips 0 in _on_peer_dead and back
+        # to 1 on the respawn's hello
+        _metrics.get_registry().gauge(
+            _metrics.labeled("cluster.up", role=role, idx=idx)).set(1.0)
         self._note_clock(role, idx, header.get("clock"))
         if (role, idx) in self._respawning:
             self._respawning.discard((role, idx))
@@ -456,6 +496,9 @@ class ServeCluster:
         if key in self._handled_dead:
             return
         self._handled_dead.add(key)
+        _metrics.get_registry().gauge(
+            _metrics.labeled("cluster.up", role=peer.role,
+                             idx=peer.index)).set(0.0)
         proc = self._procs.get(key)
         if proc is not None and proc.poll() is None:
             proc.kill()
@@ -490,6 +533,19 @@ class ServeCluster:
         if self._shutting_down:
             return
         now = time.perf_counter()
+        registry = _metrics.get_registry()
+        for (role, idx), hb in list(self._hb.items()):
+            seen = hb.get("age_clock")
+            if seen is not None:
+                # per-worker heartbeat staleness as a typed gauge: a
+                # wedged-but-connected stage shows a growing age here
+                # before the stale_after trip
+                registry.gauge(_metrics.labeled(
+                    "cluster.worker_age_s", role=role, idx=idx)
+                ).set(round(now - seen, 3))
+        if self._slo is not None and now - self._slo_last >= 1.0:
+            self._slo_last = now
+            self._slo.sample(now, self.fleet_metrics())
         for key, peer in list(self._peers.items()):
             # a peer is exempt until its "ready" frame: engine build
             # sends no heartbeats, and a cold jit compile exceeding
@@ -537,6 +593,8 @@ class ServeCluster:
                     proc.wait(timeout=10)
         for peer in list(self._peers.values()):
             peer.close()
+        if self._statusz is not None:
+            self._statusz.stop()
         self.dump_trace()
         out = self.stats()
         self._tmp.cleanup()
@@ -559,6 +617,56 @@ class ServeCluster:
         except OSError as e:
             print(f"cluster: trace dump failed: {e}", file=sys.stderr)
             return None
+
+    # ------------------------------------------------------------- statusz
+
+    def fleet_metrics(self) -> dict:
+        """Fleet-merged registry snapshot: the driver's own registry plus
+        the freshest per-worker snapshot (final stats frame or heartbeat,
+        whichever arrived later) — counters/gauges summed, histograms
+        merged bucket-for-bucket.  This is what the driver's /metricsz
+        serves and what the SLO burn-rate tracker samples."""
+        snaps = [_metrics.get_registry().snapshot()]
+        for key in set(self._worker_stats) | set(self._hb):
+            st = self._worker_stats.get(key)
+            hb = self._hb.get(key)
+            st_t = self._stats_age.get(key, -1.0)
+            hb_t = hb.get("age_clock", -1.0) if hb else -1.0
+            pick = st if st_t >= hb_t else hb
+            if pick and isinstance(pick.get("metrics"), dict):
+                snaps.append(pick["metrics"])
+        return _metrics.merge_snapshots(snaps)
+
+    def _statusz_health(self) -> dict:
+        now = time.perf_counter()
+        peers = {}
+        for (role, idx), peer in sorted(self._peers.items()):
+            hb = self._hb.get((role, idx), {})
+            seen = hb.get("age_clock")
+            peers[f"{role}:{idx}"] = {
+                "alive": peer.alive,
+                "ready": peer.ready,
+                "hb_age_s": (round(now - seen, 3)
+                             if seen is not None else None),
+            }
+        return {"pending": self.pending, "peers": peers,
+                "supervision": self.supervisor.stats()}
+
+    def _statusz_status(self) -> dict:
+        out = self.stats()
+        # the fleet-wide view: per-worker registries merged into the
+        # driver's (stats() alone reports the driver registry only)
+        out["metrics"] = self.fleet_metrics()
+        if self._slo is not None:
+            # a scrape is a sample point: push the fresh fleet view so
+            # the lifetime/burn numbers reflect this instant, not the
+            # last 1s-cadence _check_stale tick (a concurrent sample
+            # from the serving thread at worst 503s the scrape, which
+            # the client retries)
+            now = time.perf_counter()
+            self._slo.sample(now, out["metrics"])
+            out["slo"] = self._slo.evaluate(now)
+        return out
 
     # ------------------------------------------------------------------ stats
 
@@ -588,9 +696,15 @@ class ServeCluster:
             if seen is not None:
                 entry["age_s"] = round(now - seen, 3)
             heartbeats[f"{role}:{idx}"] = entry
+        statusz_ports = {}
+        if self._statusz is not None:
+            statusz_ports["driver"] = self._statusz.port
+        for (role, idx), p in sorted(self._statusz_ports.items()):
+            statusz_ports[f"{role}:{idx}"] = p
         return {
             "topology": {"prefill_procs": self.prefill_procs,
                          "replicas": self.replicas},
+            **({"statusz_ports": statusz_ports} if statusz_ports else {}),
             "router": self.router.stats(),
             "router_transport": self.counters.as_dict(),
             "transport_total": total.as_dict(),

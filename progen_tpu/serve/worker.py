@@ -41,7 +41,8 @@ honor_env_platforms()
 def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
               checkpoint_path: str | None = None, draft: str = "identity",
               engine: dict | None = None, draft_config=None,
-              heartbeat_s: float = 1.0, trace: dict | None = None) -> dict:
+              heartbeat_s: float = 1.0, trace: dict | None = None,
+              statusz: bool = False) -> dict:
     """Build the JSON-able worker spec.  ``engine`` holds
     :class:`ServingEngine` kwargs (slots/chunk/paged/spec/...);
     ``disagg`` is implied.  Params come from ``checkpoint_path`` when
@@ -49,7 +50,10 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
     every process either way.  ``trace`` (``{"dir": ..., "capacity"?}``)
     enables span tracing in every worker; each dumps its ring to
     ``trace_<role>_<index>.json`` in that directory at exit
-    (docs/OBSERVABILITY.md)."""
+    (docs/OBSERVABILITY.md).  ``statusz=True`` starts a loopback
+    introspection server in every process (driver included) on an
+    ephemeral port; workers report their port in the hello frame and the
+    driver surfaces the map on its own /statusz."""
     spec = {
         "config": config.to_dict(),
         "mixed_precision": bool(mixed_precision),
@@ -61,6 +65,8 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
     }
     if trace:
         spec["trace"] = dict(trace)
+    if statusz:
+        spec["statusz"] = True
     if draft_config is not None:
         spec["draft_config"] = draft_config.to_dict()
     return spec
@@ -325,19 +331,53 @@ def main(argv) -> int:
                           process=f"{role}:{index}")
 
     counters = TransportCounters()
+
+    # the introspection server comes up BEFORE the engine build so
+    # /healthz answers (phase "building") during a minutes-long cold jit;
+    # its port rides the hello frame for the driver's endpoint map
+    statusz_srv = None
+    holder: dict = {"phase": "connecting"}
+    if spec.get("statusz"):
+        from progen_tpu.observe.statusz import StatuszServer
+
+        def _health():
+            out = {"phase": holder["phase"],
+                   "transport": counters.as_dict()}
+            eng_ = holder.get("eng")
+            if eng_ is not None:
+                out["pending"] = eng_.pending
+                out["active"] = eng_.num_active
+            return out
+
+        def _status():
+            eng_ = holder.get("eng")
+            return (eng_.status() if eng_ is not None
+                    else {"phase": holder["phase"]})
+
+        statusz_srv = StatuszServer(
+            role=role, index=index,
+            providers={"health": _health, "status": _status})
+        statusz_srv.start()
+
     sock = connect(port)
     peer = Peer(sock, counters)
     peer.role, peer.index = role, index
     # the clock echo lets the driver estimate this process's perf_counter
     # offset, so merged trace timelines are causally ordered
-    peer.send_json({"type": "hello", "role": role, "index": index,
-                    "clock": time.perf_counter()})
+    hello = {"type": "hello", "role": role, "index": index,
+             "clock": time.perf_counter()}
+    if statusz_srv is not None:
+        hello["statusz_port"] = statusz_srv.port
+    peer.send_json(hello)
 
     print(f"worker {role}:{index} building engine", flush=True)
+    holder["phase"] = "building"
     t0 = time.perf_counter()
     eng = build_engine_from_spec(spec, remote_prefill=(role == "decode"))
     print(f"worker {role}:{index} engine ready in "
           f"{time.perf_counter() - t0:.1f}s", flush=True)
+    holder["eng"] = eng
+    holder["phase"] = "serving"
     peer.send_json({"type": "ready", "build_s": time.perf_counter() - t0})
 
     inbox: _queue.Queue = _queue.Queue()
@@ -358,6 +398,8 @@ def main(argv) -> int:
             print(f"worker {role}:{index} trace dump failed: {e}",
                   file=sys.stderr, flush=True)
     print(f"worker {role}:{index} exiting", flush=True)
+    if statusz_srv is not None:
+        statusz_srv.stop()
     peer.close()
     return 0
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import math
 import time
 from typing import Any, Sequence
 
@@ -150,6 +151,10 @@ class TrainerConfig:
     watchdog_timeout: float | None = None
     watchdog_dir: str | None = None   # default: the tracker's run dir
     flight_recorder_n: int = 64       # last-N-events ring
+    # live introspection: /healthz /statusz /metricsz /tracez /flightz on
+    # a loopback port (0 = ephemeral, printed at startup; None = off).
+    # Handlers read host-side state only — never a device sync.
+    statusz_port: int | None = None
 
 
 class Trainer:
@@ -289,6 +294,22 @@ class Trainer:
         # loop's recent phases even when tracing is off
         self._tracer = get_tracer()
         self._watchdog: Watchdog | None = None
+        # live introspection plane: health/status read the flight
+        # recorder and registry (host floats published at the loop's one
+        # batched device_get) — an enabled trainer runs the identical
+        # step sequence, the plane never syncs the device
+        self._statusz = None
+        if cfg.statusz_port is not None and jax.process_index() == 0:
+            from progen_tpu.observe.statusz import StatuszServer
+
+            self._statusz = StatuszServer(
+                role="trainer", port=cfg.statusz_port,
+                providers={"health": self._statusz_health,
+                           "status": self._statusz_status,
+                           "flight": self._recorder.snapshot})
+            port = self._statusz.start()
+            print(f"trainer statusz on http://127.0.0.1:{port}",
+                  flush=True)
         if jax.process_count() == 1:
             import signal
 
@@ -307,6 +328,39 @@ class Trainer:
         dur = time.perf_counter() - t0
         self._tracer.add(name, t0, dur, **fields)
         self._recorder.record(name, dur_s=round(dur, 6), **fields)
+
+    def _statusz_health(self) -> dict:
+        events = self._recorder.snapshot()
+        last_step = None
+        for e in reversed(events):
+            if e.get("kind") == "step":
+                last_step = e
+                break
+        return {"last_step": last_step,
+                "watchdog": self._watchdog is not None,
+                "preempt_requested": self._preempt_requested}
+
+    def _statusz_status(self) -> dict:
+        return {"model": self.model_config.to_dict(),
+                "superstep": self.cfg.superstep,
+                "batch_size": self.cfg.batch_size,
+                "max_steps": self.cfg.max_steps,
+                "recent": self._recorder.snapshot()[-16:]}
+
+    def _publish_train_health(self, log: dict, step: int) -> None:
+        """Training-health sentinels into the shared registry: the
+        trainer's /statusz shows training health, not just serving.
+        ``log`` holds host floats from the loop's one batched
+        ``jax.device_get`` — this publishes them without any extra
+        device sync."""
+        registry = get_registry()
+        registry.gauge("train.step").set(step)
+        registry.gauge("train.loss").set(log["loss"])
+        registry.gauge("train.grad_norm").set(log["grad_norm"])
+        registry.gauge("train.lr").set(log["lr"])
+        if not (math.isfinite(log["loss"])
+                and math.isfinite(log["grad_norm"])):
+            registry.counter("train.nonfinite_steps").inc()
 
     def _to_device(self, np_batch) -> jax.Array:
         """Host batch -> device array for the jitted step.
@@ -723,6 +777,7 @@ class Trainer:
                         # assembly — the loop's only blocking point
                         self._note_phase("train.log", t0, step=global_step)
                         self.meter.publish(get_registry())
+                        self._publish_train_health(log, global_step)
                         if process_index == 0:
                             print(f"step {global_step} loss: {last_loss:.4f}")
 
@@ -900,6 +955,7 @@ class Trainer:
                         # assembly — the loop's only blocking point
                         self._note_phase("train.log", t0, step=global_step)
                         self.meter.publish(get_registry())
+                        self._publish_train_health(log, global_step)
                         if process_index == 0:
                             print(f"step {global_step} loss: {last_loss:.4f}")
 

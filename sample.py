@@ -100,6 +100,11 @@ honor_env_platforms()
               help="engine: seconds without a completed serve step before "
                    "the watchdog dumps all-thread stacks to CWD and exits "
                    "nonzero (unset = off); compiles are exempt")
+@click.option("--statusz", is_flag=True,
+              help="with --serve_procs: serve live /healthz /statusz "
+                   "/metricsz in every process (driver + workers) on "
+                   "ephemeral loopback ports, printed at startup; "
+                   "zero-perturbation (docs/OBSERVABILITY.md)")
 @click.option("--trace", is_flag=True,
               help="record request spans in every serving process and "
                    "merge them into one Perfetto trace.json under "
@@ -118,7 +123,8 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
          seq_len, mesh_spec, strategies, serve, embed_mode, infill, slots,
          chunk, paged, page_size, serve_attempts, snapshot_path, aot_warmup,
          spec, spec_k, disagg, serve_procs, prefill_procs, replicas,
-         watchdog_timeout, trace, trace_out, xprof_dir, compile_cache):
+         watchdog_timeout, statusz, trace, trace_out, xprof_dir,
+         compile_cache):
     import os
 
     import jax
@@ -262,9 +268,14 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                             max_len=seq_len, paged=paged,
                             page_size=page_size, spec=spec, spec_k=spec_k),
                 trace=({"dir": os.path.abspath(trace_out)}
-                       if trace else None))
+                       if trace else None),
+                statusz=statusz)
             cluster = ServeCluster(wspec, prefill_procs=prefill_procs,
                                    replicas=replicas)
+            if statusz:
+                ports = cluster.stats().get("statusz_ports", {})
+                for who, p in sorted(ports.items()):
+                    print(f"statusz[{who}]: http://127.0.0.1:{p}")
             try:
                 with profile_trace(xprof_dir):
                     for r in requests:

@@ -331,6 +331,11 @@ def _bare_cluster(prefill=1, replicas=1, max_restarts=0):
     from progen_tpu.observe import trace as _trace
     c._tracer = _trace.get_tracer()
     c._lat = _metrics.get_registry().histogram("cluster.latency_s")
+    c._ok_ctr = _metrics.get_registry().counter("cluster.completions_ok")
+    c._shed_ctr = _metrics.get_registry().counter("cluster.completions_shed")
+    c._statusz = None
+    c._statusz_ports = {}
+    c._slo, c._slo_last = None, 0.0
     c._shutting_down = False
     c._spawn = lambda role, idx: None    # supervision grants don't fork
     for i in range(prefill):

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""benchdiff CLI: compare two bench JSONL records and gate on regression.
+
+Usage:
+
+    python tools/benchdiff.py baseline.jsonl candidate.jsonl
+    python tools/benchdiff.py --metric serving_multiproc a.jsonl b.jsonl
+    python tools/benchdiff.py --band tokens_per_sec=0.25 a.jsonl b.jsonl
+
+Each input is a JSONL file of ``stamp_record`` outputs (every record
+carries ``git_sha`` + ``wall_time``).  For each side, the comparator
+takes the LATEST record (by ``wall_time``) per ``metric`` family —
+optionally restricted with ``--metric`` — and diffs every watched
+numeric field that both sides carry.  A delta beyond the metric's noise
+band, in the metric's BAD direction, is a regression:
+
+* higher-is-better: ``tokens_per_sec``, ``goodput_tokens_per_sec``,
+  ``within_slo_frac``, ``accepted_tokens_per_step``
+* lower-is-better: ``p50_latency_s``, ``p95_latency_s``, ``wall_s``,
+  ``slo_burn_rate``
+
+Default noise bands are deliberately wide (CPU-proof benches on shared
+runners are noisy); tighten per-metric with ``--band name=frac``.
+``tools/check.sh`` runs this twice on the quick-bench record: a
+self-diff must pass, and a synthetically degraded copy must fail.
+
+Exit codes: 0 no regression, 1 regression detected, 2 usage error
+(missing/empty/unmatchable inputs).  Pure stdlib; never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# watched field -> (direction, default relative noise band)
+#   +1: higher is better (regression = candidate below baseline)
+#   -1: lower is better  (regression = candidate above baseline)
+WATCHED: dict[str, tuple[int, float]] = {
+    "tokens_per_sec": (+1, 0.30),
+    "goodput_tokens_per_sec": (+1, 0.30),
+    "within_slo_frac": (+1, 0.10),
+    "accepted_tokens_per_step": (+1, 0.15),
+    "p50_latency_s": (-1, 0.40),
+    "p95_latency_s": (-1, 0.40),
+    "wall_s": (-1, 0.40),
+    "slo_burn_rate": (-1, 0.50),
+}
+
+
+def load_latest(path: str, metric: str | None) -> dict[str, dict]:
+    """Latest record per ``metric`` family in a JSONL file, ordered by
+    the ``wall_time`` stamp (falling back to file order when absent)."""
+    latest: dict[str, dict] = {}
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as e:
+        raise SystemExit(f"benchdiff: cannot read {path}: {e}")
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            print(f"benchdiff: {path}:{i + 1}: skipping unparseable line",
+                  file=sys.stderr)
+            continue
+        fam = rec.get("metric")
+        if not fam or (metric and fam != metric):
+            continue
+        prev = latest.get(fam)
+        if prev is None or (rec.get("wall_time", i) >=
+                            prev.get("wall_time", -1)):
+            latest[fam] = rec
+    return latest
+
+
+def compare(base: dict, cand: dict, bands: dict[str, float]) -> list[dict]:
+    """Diff every watched field both records carry; return regressions."""
+    regressions = []
+    for field, (direction, default_band) in WATCHED.items():
+        b, c = base.get(field), cand.get(field)
+        if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        band = bands.get(field, default_band)
+        # relative delta in the BAD direction; denominator floored so a
+        # ~0 baseline (e.g. p50 under a fast config) can't blow up
+        scale = max(abs(b), 1e-9)
+        bad_delta = (b - c) / scale if direction > 0 else (c - b) / scale
+        status = "REGRESSED" if bad_delta > band else "ok"
+        row = {"field": field, "baseline": b, "candidate": c,
+               "delta_frac": round(bad_delta, 4), "band": band,
+               "status": status}
+        print(f"  {field:<28} {b:>12g} -> {c:>12g}  "
+              f"bad-delta {bad_delta:+.1%} (band {band:.0%})  {status}")
+        if status == "REGRESSED":
+            regressions.append(row)
+    return regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare two bench JSONL records; nonzero on regression")
+    ap.add_argument("baseline", help="baseline JSONL (the good run)")
+    ap.add_argument("candidate", help="candidate JSONL (the run under test)")
+    ap.add_argument("--metric", default=None,
+                    help="only compare this metric family "
+                         "(e.g. serving, serving_multiproc)")
+    ap.add_argument("--band", action="append", default=[],
+                    metavar="FIELD=FRAC",
+                    help="override a field's relative noise band, "
+                         "e.g. tokens_per_sec=0.25 (repeatable)")
+    args = ap.parse_args(argv)
+
+    bands: dict[str, float] = {}
+    for spec in args.band:
+        field, eq, frac = spec.partition("=")
+        if not eq or field not in WATCHED:
+            print(f"benchdiff: bad --band {spec!r} "
+                  f"(known fields: {', '.join(sorted(WATCHED))})",
+                  file=sys.stderr)
+            return 2
+        try:
+            bands[field] = float(frac)
+        except ValueError:
+            print(f"benchdiff: bad --band fraction {frac!r}", file=sys.stderr)
+            return 2
+
+    base = load_latest(args.baseline, args.metric)
+    cand = load_latest(args.candidate, args.metric)
+    shared = sorted(set(base) & set(cand))
+    if not shared:
+        print(f"benchdiff: no shared metric families between "
+              f"{args.baseline} ({sorted(base) or 'empty'}) and "
+              f"{args.candidate} ({sorted(cand) or 'empty'})",
+              file=sys.stderr)
+        return 2
+
+    all_regressions = []
+    compared = 0
+    for fam in shared:
+        b, c = base[fam], cand[fam]
+        print(f"{fam}: baseline sha {b.get('git_sha', '?')[:12]} -> "
+              f"candidate sha {c.get('git_sha', '?')[:12]}")
+        rows = compare(b, c, bands)
+        compared += sum(1 for f in WATCHED
+                        if isinstance(b.get(f), (int, float))
+                        and isinstance(c.get(f), (int, float)))
+        all_regressions.extend({"metric": fam, **r} for r in rows)
+    if compared == 0:
+        print("benchdiff: no watched numeric fields shared by both sides",
+              file=sys.stderr)
+        return 2
+
+    if all_regressions:
+        print(f"benchdiff: {len(all_regressions)} regression(s):",
+              file=sys.stderr)
+        for r in all_regressions:
+            print(f"  {r['metric']}.{r['field']}: {r['baseline']} -> "
+                  f"{r['candidate']} ({r['delta_frac']:+.1%} beyond "
+                  f"{r['band']:.0%} band)", file=sys.stderr)
+        return 1
+    print("benchdiff: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,12 +63,47 @@ echo "== trace smoke =="
 # Perfetto-loadable trace.json, and traceview must find + summarize the
 # spans (exit 0).  docs/OBSERVABILITY.md has the design.
 TRACE_DIR="$(mktemp -d)"
-trap 'rm -rf "$TRACE_DIR"' EXIT
+BENCH_DIR="$(mktemp -d)"
+trap 'rm -rf "$TRACE_DIR" "$BENCH_DIR"' EXIT
 JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
     --config default --requests 4 --rate 50 --slots 2 --chunk 4 \
     --max-new 6 --prime-min 4 --prime-max 12 \
     --serve-procs --trace --trace-out "$TRACE_DIR"
 python tools/traceview.py --summarize "$TRACE_DIR/trace.json"
+
+echo "== statusz smoke =="
+# real 2-process cluster with the live introspection plane on: every
+# process (driver + prefill worker + decode replica) serves /healthz and
+# /metricsz on a loopback port and the bench self-checks each endpoint
+# mid-run — 200, parseable JSON health, strict Prometheus exposition
+# (docs/OBSERVABILITY.md §statusz)
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 4 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --serve-procs --statusz
+
+echo "== benchdiff regression gate =="
+# compare the superstep quick-bench record against itself (must pass),
+# then against a synthetically degraded copy (must FAIL nonzero) — the
+# gate that catches a perf regression before it ships
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 4 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --out "$BENCH_DIR/base.jsonl"
+python tools/benchdiff.py "$BENCH_DIR/base.jsonl" "$BENCH_DIR/base.jsonl"
+python - "$BENCH_DIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+rec = json.loads(open(f"{d}/base.jsonl").readline())
+rec["tokens_per_sec"] = rec["tokens_per_sec"] * 0.2   # -80%: regression
+rec["p95_latency_s"] = rec.get("p95_latency_s", 1.0) * 5 + 1.0
+rec["wall_time"] = rec.get("wall_time", 0) + 1
+open(f"{d}/bad.jsonl", "w").write(json.dumps(rec) + "\n")
+EOF
+if python tools/benchdiff.py "$BENCH_DIR/base.jsonl" "$BENCH_DIR/bad.jsonl"; then
+    echo "benchdiff FAILED to flag an injected regression" >&2
+    exit 1
+fi
 
 echo "== scenario-mix smoke =="
 # all four workload classes (generate / constrained infill / embeddings /

@@ -19,7 +19,9 @@ same ``Histogram`` the benches use — one percentile code path).
 ``--top N`` prints the N slowest requests by first-span..last-span wall
 time, grouped by trace id (request uid).
 
-Exit codes: 0 success, 1 no spans found, 2 usage error.
+Exit codes: 0 success, 1 no spans found (merge mode only — the read-only
+``--summarize`` / ``--top`` views degrade to a message and exit 0 on an
+empty or driver-only dump directory), 2 usage error.
 
 Pure stdlib + ``progen_tpu.observe`` (itself stdlib-only for these two
 modules); the heavy package ``__init__`` is bypassed with a namespace
@@ -143,6 +145,13 @@ def main(argv=None) -> int:
     trace_mod, metrics_mod = _import_observe()
     spans, dumps = _collect(args.paths, trace_mod)
     if not spans:
+        # a driver-only or pre-traffic dump directory is a normal state
+        # for the read-only views — report it and exit clean so scripted
+        # `traceview --summarize` probes don't fail the pipeline
+        if args.summarize or args.top:
+            print("traceview: no spans found (nothing to summarize)",
+                  file=sys.stderr)
+            return 0
         print("traceview: no spans found", file=sys.stderr)
         return 1
 
@@ -163,7 +172,7 @@ def main(argv=None) -> int:
 
     if args.summarize:
         rows = summarize(spans, metrics_mod)
-        width = max(len(r["name"]) for r in rows)
+        width = max((len(r["name"]) for r in rows), default=4)
         print(f"{'span':<{width}}  {'count':>6}  {'total_s':>10}  "
               f"{'p50_ms':>9}  {'p95_ms':>9}")
         for r in rows:

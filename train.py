@@ -122,6 +122,13 @@ def _load_model_config(config_path: str, model_name: str) -> dict:
                    "dumps all-thread stacks + the flight recorder to the "
                    "run dir and exits nonzero (unset = off); size it to "
                    "several worst-case step times")
+@click.option("--statusz", "statusz_port", default=None, type=int,
+              flag_value=0, is_flag=False,
+              help="serve live /healthz /statusz /metricsz /tracez "
+                   "/flightz on this loopback port (bare --statusz = "
+                   "ephemeral port, printed at startup); handlers read "
+                   "host state only — zero perturbation "
+                   "(docs/OBSERVABILITY.md)")
 @click.option("--warm_sampler/--no_warm_sampler", default=True,
               help="pre-loop sampler warm execution (minutes of decode "
                    "compile); auto-skipped when no sample hook can fire, "
@@ -210,6 +217,7 @@ def main(**flags):
         profile_dir=flags["profile_dir"],
         run_attempts=flags["run_attempts"],
         watchdog_timeout=flags["watchdog_timeout"],
+        statusz_port=flags["statusz_port"],
         warm_sampler=flags["warm_sampler"],
     )
 
