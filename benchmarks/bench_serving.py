@@ -157,6 +157,29 @@ def main() -> None:
                     help="prefill worker processes (with --serve-procs)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="decode replica processes (with --serve-procs)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="with --serve-procs: run the elastic control "
+                         "plane (serve/control.py) between poll rounds — "
+                         "scale the fleet on SLO burn rate and queue "
+                         "depth within the min/max bounds; the record "
+                         "gains the control journal summary")
+    ap.add_argument("--min-prefill", type=int, default=None,
+                    help="autoscale floor for prefill workers "
+                         "(default: --prefill-procs)")
+    ap.add_argument("--max-prefill", type=int, default=None,
+                    help="autoscale ceiling for prefill workers "
+                         "(default: --prefill-procs + 2)")
+    ap.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscale floor for decode replicas "
+                         "(default: --replicas)")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscale ceiling for decode replicas "
+                         "(default: --replicas + 2)")
+    ap.add_argument("--swap-at", type=int, default=None,
+                    help="with --serve-procs: after N served completions, "
+                         "hot-swap weights via a rolling worker upgrade "
+                         "(new generation, zero dropped requests); the "
+                         "record gains the swap outcome")
     ap.add_argument("--long-frac", type=float, default=0.0,
                     help="fraction of requests with near-max_len primes "
                          "(mixed long-prefill load); the rest draw short "
@@ -705,6 +728,16 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
     def drive_cluster():
         cluster = ServeCluster(wspec, prefill_procs=args.prefill_procs,
                                replicas=args.replicas)
+        control = None
+        if args.autoscale or args.swap_at is not None:
+            from progen_tpu.serve import BurnRatePolicy, ControlPlane
+
+            control = ControlPlane(cluster, BurnRatePolicy(
+                min_prefill=args.min_prefill or args.prefill_procs,
+                max_prefill=args.max_prefill or args.prefill_procs + 2,
+                min_replicas=args.min_replicas or args.replicas,
+                max_replicas=args.max_replicas or args.replicas + 2,
+                cooldown_s=2.0))
         try:
             # warm the fleet off the clock: sacrificial requests compile
             # prefill + merge + chunk programs in the workers
@@ -725,6 +758,12 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
             t0 = time.perf_counter()
             served: list = []
             nxt = 0
+            # fleet size over time: [t_rel_s, prefill_workers, replicas]
+            # — flat without --autoscale, the scaling story with it
+            timeline = [[0.0, cluster.prefill_procs, cluster.replicas]]
+            last_sample = 0.0
+            last_tick = -1e9
+            swapped_gen = None
             while len(served) < args.requests:
                 now = time.perf_counter() - t0
                 while nxt < args.requests and arrivals[nxt] <= now:
@@ -732,13 +771,53 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
                                                 ttl=args.ttl))
                     nxt += 1
                 served.extend(cluster.poll(0.02))
+                if (control is not None and args.swap_at is not None
+                        and swapped_gen is None
+                        and len(served) >= args.swap_at):
+                    swapped_gen = control.swap_weights()
+                now = time.perf_counter() - t0
+                if (control is not None and args.autoscale
+                        and now - last_tick >= 0.25):
+                    last_tick = now
+                    control.tick()
+                    now = time.perf_counter() - t0
+                if (now - last_sample >= 0.25
+                        or timeline[-1][1:] != [cluster.prefill_procs,
+                                                cluster.replicas]):
+                    last_sample = now
+                    timeline.append([round(now, 3),
+                                     cluster.prefill_procs,
+                                     cluster.replicas])
             wall = time.perf_counter() - t0
+            timeline.append([round(wall, 3), cluster.prefill_procs,
+                             cluster.replicas])
+            extras = {"fleet_size_timeline": timeline}
+            if control is not None:
+                events = [e["event"] for e in control.journal]
+                extras["control"] = {
+                    "scale_ups": events.count("scale_up"),
+                    "scale_downs": events.count("scale_down"),
+                    "swaps": control.swaps,
+                    "generation": cluster.generation,
+                    "journal": control.journal[-64:],
+                }
+            if swapped_gen is not None:
+                gens = {c.uid: c.generation for c in served}
+                extras["swap"] = {
+                    "at_completions": args.swap_at,
+                    "generation": swapped_gen,
+                    "served_old_gen": sum(
+                        1 for g in gens.values() if g < swapped_gen),
+                    "served_new_gen": sum(
+                        1 for g in gens.values() if g >= swapped_gen),
+                    "dropped": args.requests - len(gens),
+                }
         finally:
             stats = cluster.shutdown()
-        return served, wall, stats
+        return served, wall, stats, extras
 
     with profile_trace(args.xprof_dir):
-        done, wall, stats = drive_cluster()
+        done, wall, stats, extras = drive_cluster()
     ok = [c for c in done if c.ok]
     lat = sorted(c.latency for c in ok) or [0.0]
     c50, c95 = latency_percentiles(lat, name="bench.cluster_latency_s")
@@ -800,6 +879,8 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
         "sp_disagg": sp_disagg,
         "inline": inline,
         "platform": jax.devices()[0].platform,
+        "autoscale": args.autoscale,
+        **extras,
     })
 
     if args.verify:
@@ -815,8 +896,9 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
             f"multi-process serving diverged from the single-process "
             f"engine for uids {mismatched}")
         # replay parity: a SECOND fresh cluster (new processes, new
-        # placement) must serve bit-identical tokens
-        done2, _, _ = drive_cluster()
+        # placement — and its own scaling/swap timing) must serve
+        # bit-identical tokens
+        done2, _, _, _ = drive_cluster()
         first = {c.uid: [int(t) for t in c.tokens] for c in done if c.ok}
         second = {c.uid: [int(t) for t in c.tokens] for c in done2 if c.ok}
         assert first == second, "cluster replay diverged between runs"

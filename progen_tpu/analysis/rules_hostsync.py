@@ -97,7 +97,9 @@ HOT_ZONES: tuple[Zone, ...] = (
          frozenset({"prefill_alive", "replica_alive", "prefill_load",
                     "outstanding", "requests", "stage", "batches",
                     "_uid_batch", "completed", "submit_times",
-                    "max_prefill_queue", "max_outstanding"})),
+                    "max_prefill_queue", "max_outstanding",
+                    "prefill_fenced", "replica_fenced",
+                    "prefill_gen", "replica_gen", "uid_gen"})),
     # the cluster's ADMISSION/event side must not sync (wire headers are
     # parsed JSON; numpy-building lives in module helpers outside the
     # zone); spawn/accept/log plumbing is transport-side and unzoned
@@ -113,7 +115,32 @@ HOT_ZONES: tuple[Zone, ...] = (
                     "stale_after", "prefill_procs", "replicas",
                     "spec", "_tracer", "_lat", "_clock_offsets",
                     "_stats_age", "_statusz", "_statusz_ports",
-                    "_slo", "_slo_last", "_ok_ctr", "_shed_ctr"})),
+                    "_slo", "_slo_last", "_ok_ctr", "_shed_ctr",
+                    "generation", "_worker_gen", "_worker_spec",
+                    "_retiring", "_pending_routable", "_next_idx",
+                    "_spec_paths", "_statusz_providers"})),
+    # the control plane's tick sits between poll rounds on the drive
+    # loop: pure host policy over router/heartbeat bookkeeping, any
+    # sync here would stall every request in flight
+    Zone(r"serve/control\.py$",
+         r"(ControlPlane\.(gather|tick|_pick_victim|_journal|controlz)"
+         r"|_worst_burns)$",
+         frozenset({"cluster", "policy", "journal", "ticks", "swaps",
+                    "_last_inputs", "_tracer", "_slo", "_up_ctr",
+                    "_down_ctr", "_swap_ctr", "_g_prefill",
+                    "_g_replicas", "_g_gen"}),
+         # SLO evaluate results and heartbeat stage_seconds are
+         # JSON-safe host floats by contract
+         frozenset({"slo_results"})),
+    Zone(r"serve/policy\.py$",
+         r"(BurnRatePolicy\.(decide|note_action|_cooling|config)"
+         r"|_worst_burn|PolicyInputs\..*|ScaleDecision\..*)$",
+         frozenset({"min_prefill", "max_prefill", "min_replicas",
+                    "max_replicas", "up_burn", "down_burn",
+                    "up_queue_per_worker", "down_queue_per_worker",
+                    "cooldown_s", "_last_action"}),
+         # PolicyInputs fields are host floats/dicts by contract
+         frozenset({"inputs", "burn_rates"})),
     # span recording sits on every hot path above: it must never sync
     # (spans carry pre-computed floats, never device values)
     Zone(r"observe/trace\.py$", r"Tracer\.(span|add|event)$"),

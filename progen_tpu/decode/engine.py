@@ -130,6 +130,7 @@ STATUS_OK = "ok"
 SHED_QUEUE_FULL = "shed_queue_full"
 SHED_DEADLINE = "shed_deadline"
 FAILED_FAULT = "failed_fault"
+DRAIN_TIMEOUT = "drain_timeout"
 
 # consecutive rounds a phase may defer (fatal-fault containment) before
 # the engine concludes the fault is permanent and gives up
@@ -206,6 +207,9 @@ class Completion:
     finish_time: float
     status: str = STATUS_OK
     embedding: np.ndarray | None = None  # (D,) f32 for embed requests
+    # weight generation that primed the request — the serving control
+    # plane bumps this on swap_weights; 0 for a never-swapped engine
+    generation: int = 0
 
     @property
     def latency(self) -> float:
@@ -334,14 +338,12 @@ class ServingEngine:
         self.disagg = disagg
         self.lora = lora_bank is not None
         if self.lora:
-            # composition bounds: the adapter gather composes with dense
-            # and paged decode; the spec draft/commit scans and the
-            # disagg handle transport do not carry tenant state (yet)
+            # composition bounds: the adapter gather composes with dense,
+            # paged, and disaggregated decode (the handle carries a
+            # ``tenant`` leaf in its state tree); the spec draft/commit
+            # scans do not carry tenant state (yet)
             if spec:
                 raise ValueError("lora_bank does not compose with spec=True")
-            if disagg:
-                raise ValueError("lora_bank does not compose with "
-                                 "disagg=True")
             from progen_tpu.workloads.lora import validate_lora_bank
 
             self.num_tenants = validate_lora_bank(config, lora_bank)
@@ -384,6 +386,11 @@ class ServingEngine:
         else:
             self._max_advance = chunk_size
             self._params = params
+        # weight generation: bumped by reload_weights(); completions are
+        # stamped with the generation current when they finish (in the
+        # multi-process cluster the driver stamps from router bookkeeping
+        # instead — a uid's generation is the one that PRIMED it)
+        self.generation = 0
 
         if mesh is not None:
             from progen_tpu.parallel.sharding import logical_rules
@@ -954,17 +961,20 @@ class ServingEngine:
     # ------------------------------------------------- disaggregated serving
 
     def _prefill_worker_impl(self, params, tokens, lengths, stops, seeds,
-                             top_k, temp, lmask):
+                             top_k, temp, lmask, tenant=None):
         """Prefill stage of disaggregated serving: same math as the admit
         impls but with NO slot state in scope — the product is a handle
         of ``(num_slots, ...)`` slabs the merge program later gathers
         into slots.  Gate rows stay dense here even in paged mode (the
         worker cannot know which pool pages the rows will land in; the
-        merge scatters them through a row-indexed write table)."""
+        merge scatters them through a row-indexed write table).
+        ``tenant (S,)`` rides only under LoRA and travels in the handle
+        state so the decode side keeps gathering the right adapter."""
         cfg = self.config
         with self._trace_ctx():
             logits, varz = self._prefill_model.apply(
-                self._target_params(params), tokens, mutable=["cache"])
+                self._target_params(params), tokens,
+                self._adapters(params), tenant, mutable=["cache"])
             caches = harvest_caches(cfg, varz["cache"], lengths,
                                     self.policy, self.max_len)
             if self.mesh is not None:
@@ -1006,6 +1016,8 @@ class ServingEngine:
             "temp": temp,
             "lmask": lmask,
         }
+        if self.lora:
+            out["tenant"] = tenant
         if self.spec:
             out["draft_caches"] = draft_caches
         return out
@@ -1057,6 +1069,8 @@ class ServingEngine:
             "temp": take(hstate["temp"], state["temp"]),
             "lmask": take(hstate["lmask"], state["lmask"]),
         }
+        if self.lora:
+            out["tenant"] = take(hstate["tenant"], state["tenant"])
         if self.spec:
             out["draft_caches"] = jax.tree.map(
                 take, hstate["draft_caches"], state["draft_caches"])
@@ -1241,7 +1255,8 @@ class ServingEngine:
             tokens=np.asarray(  # graftcheck: disable=host-sync
                 [] if tokens is None else tokens, np.int32),
             finish_reason=status, status=status,
-            submit_time=r.submit_time, finish_time=time.perf_counter())
+            submit_time=r.submit_time, finish_time=time.perf_counter(),
+            generation=self.generation)
         self.completions.append(comp)
         self._pending.append(comp)
         self._tracer.event("serve.shed", trace=r.uid, status=status)
@@ -1519,7 +1534,7 @@ class ServingEngine:
                 uid=r.uid, prime=np.asarray(r.tokens, np.int32),
                 tokens=np.zeros((0,), np.int32), finish_reason="embed",
                 submit_time=r.submit_time, finish_time=now,
-                embedding=vecs[row])
+                embedding=vecs[row], generation=self.generation)
             self.completions.append(comp)
             self._pending.append(comp)
             if r.on_complete is not None:
@@ -1558,6 +1573,7 @@ class ServingEngine:
         seeds = np.zeros((s,), np.uint32)
         top_k = np.zeros((s,), np.int32)
         temp = np.ones((s,), np.float32)
+        tenant = np.zeros((s,), np.int32)
         for row, r in enumerate(batch):
             t = np.asarray(r.tokens, np.int32)
             tokens[row, : len(t)] = t
@@ -1566,14 +1582,16 @@ class ServingEngine:
             seeds[row] = np.uint32(int(r.seed) & 0xFFFFFFFF)
             top_k[row] = 0 if r.top_k is None else int(r.top_k)
             temp[row] = float(r.temperature)
+            tenant[row] = int(r.tenant)
         # handle-ROW-indexed, like every other slab the worker produces
         lmask = self._build_lmask(list(enumerate(batch)))
+        extra = (tenant,) if self.lora else ()
         t0 = time.perf_counter()
         try:
             with jax.profiler.TraceAnnotation("serve.prefill"):
                 h = self._guard(
                     "serve.prefill", self._prefill_worker_call, tokens,
-                    lengths, stops, seeds, top_k, temp, lmask,
+                    lengths, stops, seeds, top_k, temp, lmask, *extra,
                     key=("prefill", p_pad))
             self._note_stage("prefill_s", "serve.prefill", t0,
                              uids=[r.uid for r in batch], p_pad=p_pad)
@@ -1839,7 +1857,8 @@ class ServingEngine:
             comp = Completion(
                 uid=r.uid, prime=np.asarray(r.tokens, np.int32),
                 tokens=toks, finish_reason=reason,
-                submit_time=r.submit_time, finish_time=now)
+                submit_time=r.submit_time, finish_time=now,
+                generation=self.generation)
             out.append(comp)
             if r.on_complete is not None:
                 r.on_complete(comp)
@@ -2139,6 +2158,59 @@ class ServingEngine:
 
     # ----------------------------------------------------- warmup + counters
 
+    def reload_weights(self, params=None, lora_bank=None, *,
+                       generation: int | None = None) -> int:
+        """Swap the served weights in place — no recompiles, no dropped
+        slots.  Params (and the LoRA adapter bank) are real ARGUMENTS of
+        every compiled program, so replacing the pytree with an
+        identically-shaped one is just a different argument on the next
+        dispatch; in-flight slots continue on the new weights from their
+        next step, which is why the serving control plane instead swaps
+        at WORKER granularity (drain old, route new) to keep
+        per-generation determinism.  Returns the new generation tag
+        (``generation`` when given, else the old tag + 1); completions
+        finishing after the swap carry it.
+        """
+        if params is None and lora_bank is None:
+            raise ValueError("reload_weights needs params and/or lora_bank")
+        if lora_bank is not None and not self.lora:
+            raise ValueError("engine was built without a LoRA bank; the "
+                             "bank's shape is baked into its programs")
+
+        def _swap(new, old, what):
+            new = jax.tree.map(jnp.asarray, new)
+            if jax.tree.structure(new) != jax.tree.structure(old):
+                raise ValueError(f"reload_weights: {what} tree structure "
+                                 "does not match the serving tree")
+            for a, b in zip(jax.tree.leaves(new), jax.tree.leaves(old)):
+                if a.shape != b.shape or a.dtype != b.dtype:
+                    raise ValueError(
+                        f"reload_weights: {what} leaf mismatch "
+                        f"{a.shape}/{a.dtype} vs {b.shape}/{b.dtype}")
+            return new
+
+        if self.spec:
+            if params is not None:
+                self._params = {**self._params, "target": _swap(
+                    params, self._params["target"], "params")}
+        elif self.lora:
+            bundle = dict(self._params)
+            if params is not None:
+                bundle["base"] = _swap(params, self._params["base"],
+                                       "params")
+            if lora_bank is not None:
+                from progen_tpu.workloads.lora import validate_lora_bank
+
+                validate_lora_bank(self.config, lora_bank)
+                bundle["adapters"] = _swap(
+                    lora_bank, self._params["adapters"], "lora_bank")
+            self._params = bundle
+        else:
+            self._params = _swap(params, self._params, "params")
+        self.generation = (int(generation) if generation is not None
+                           else self.generation + 1)
+        return self.generation
+
     def aot_warmup(self, max_prime: int | None = None, *,
                    embed: bool = False) -> dict:
         """Explicitly compile the engine's whole program grid ahead of
@@ -2185,6 +2257,8 @@ class ServingEngine:
                     continue
                 pre_args = [params_sd, i32(s, p_pad), i32(s), i32(s),
                             u32((s,)), i32(s), f32((s,)), b8((s, L, V))]
+                if self.lora:
+                    pre_args += [i32(s)]
                 self._aot[key] = (
                     self._prefill_worker.lower(*pre_args).compile())
                 self._compiled_keys.add(key)
@@ -2207,10 +2281,11 @@ class ServingEngine:
         if self.disagg and ("merge",) not in self._aot:
             # the handle's shape is bucket-independent (everything is
             # harvested to max_len), so any bucket's worker sizes it
-            h_sd = jax.eval_shape(
-                self._prefill_worker_impl, params_sd, i32(s, buckets[0]),
-                i32(s), i32(s), u32((s,)), i32(s), f32((s,)),
-                b8((s, L, V)))
+            h_args = [params_sd, i32(s, buckets[0]), i32(s), i32(s),
+                      u32((s,)), i32(s), f32((s,)), b8((s, L, V))]
+            if self.lora:
+                h_args += [i32(s)]
+            h_sd = jax.eval_shape(self._prefill_worker_impl, *h_args)
             gate_sd: dict = {}
             if self.paged:
                 gate_sd = h_sd["caches"]["sgu_gate"]

@@ -31,6 +31,10 @@ Endpoints:
   ``metrics`` provider's registry snapshot.
 - ``/tracez``   — recent span ring (JSON), ``/flightz`` — flight
   recorder events (JSON).
+- ``/controlz`` — elastic control-plane journal (JSON): every
+  scale/swap/retire decision with its cause signal, plus policy config
+  and live fleet state.  Served only when a control plane registered
+  its ``control`` provider (``serve/control.py``); 404 otherwise.
 """
 
 from __future__ import annotations
@@ -135,7 +139,10 @@ class StatuszServer:
                  port: int = 0, providers: dict | None = None):
         self.role = role
         self.index = index
-        self.providers = dict(providers or {})
+        # held by REFERENCE: the owner may register providers after
+        # start() (the serving control plane adds "control" when it
+        # attaches to a running cluster)
+        self.providers = providers if providers is not None else {}
         self._want_port = port
         self.port: int | None = None
         self._httpd = None
@@ -218,6 +225,13 @@ class StatuszServer:
                                "spans": tracer.ring()[-512:]})
         if path == "/flightz":
             return self._json({"events": self._call("flight", [])})
+        if path == "/controlz":
+            # elastic control plane: journal of scale/swap/retire
+            # decisions + policy config + live fleet (serve/control.py);
+            # 404 when no control plane is attached
+            if "control" not in self.providers:
+                raise KeyError(path)
+            return self._json(self._call("control", {}))
         raise KeyError(path)
 
     @staticmethod

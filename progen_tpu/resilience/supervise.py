@@ -68,6 +68,20 @@ class StageSupervisor:
     def restarts_used(self, role: str, index: int) -> int:
         return self._counts.get((role, index), 0)
 
+    # The control plane (serve/control.py) grows and shrinks the fleet:
+    # a freshly scaled-up instance gets a FULL budget simply by being a
+    # new (role, index) — indices are never reused — and a retired
+    # instance is forgotten so its history can't be charged to a future
+    # worker, nor linger in the stats of a long-lived cluster.
+
+    def forget(self, role: str, index: int) -> None:
+        """Drop all supervision state for a retired stage instance."""
+        key = (role, index)
+        self._counts.pop(key, None)
+        self._last.pop(key, None)
+        self.events.append(StageEvent(role, index, True, "retired",
+                                      time.perf_counter()))
+
     def stats(self) -> dict:
         return {
             "max_restarts": self.max_restarts,

@@ -20,14 +20,26 @@ runtime:
 Placement is invisible in the tokens: a multi-process cluster produces
 bit-identical completions to the single-process engine on the same
 request set, greedy and sampled (``tests/test_serve_multiproc.py``).
+
+The **elastic control plane** (``control.py`` + ``policy.py``) makes
+the fleet itself dynamic: SLO-burn-driven autoscaling between min/max
+bounds, zero-downtime rolling weight swaps (generation-tagged), and
+graceful scale-down with zero sheds — all journaled on ``/controlz``
+(``tests/test_elastic.py``).
 """
 
 from progen_tpu.serve.cluster import ServeCluster
+from progen_tpu.serve.control import ControlPlane
+from progen_tpu.serve.policy import BurnRatePolicy, PolicyInputs, ScaleDecision
 from progen_tpu.serve.router import Router
 from progen_tpu.serve.worker import build_engine_from_spec, make_spec
 
 __all__ = [
+    "BurnRatePolicy",
+    "ControlPlane",
+    "PolicyInputs",
     "Router",
+    "ScaleDecision",
     "ServeCluster",
     "build_engine_from_spec",
     "make_spec",

@@ -34,6 +34,7 @@ from pathlib import Path
 import numpy as np
 
 from progen_tpu.decode.engine import (
+    DRAIN_TIMEOUT,
     FAILED_FAULT,
     SHED_DEADLINE,
     Completion,
@@ -105,6 +106,15 @@ class ServeCluster:
         self._handled_dead: set = set()
         self._respawning: set = set()
         self._parked_uids: list = []
+        # elastic control-plane state: the fleet and its weights are
+        # MUTABLE — see add_worker/fence_worker/retire_worker and
+        # begin_generation (serve/control.py drives these)
+        self.generation = 0                  # current weight generation
+        self._worker_gen: dict = {}          # (role, idx) -> generation
+        self._worker_spec: dict = {}         # (role, idx) -> spec Path
+        self._retiring: set = set()          # planned exits (no restart)
+        self._pending_routable: set = set()  # spawned, awaiting ready
+        self._next_idx = {"prefill": prefill_procs, "decode": replicas}
         self._worker_stats: dict = {}
         self._stats_age: dict = {}           # (role, idx) -> capture clock
         self._hb: dict = {}
@@ -123,6 +133,7 @@ class ServeCluster:
         # heartbeat/stats frames already) merged bucket-for-bucket with
         # its own registry, plus multi-window SLO burn rates
         self._statusz = None
+        self._statusz_providers: dict = {}
         self._slo = None
         self._slo_last = 0.0
         if spec.get("statusz"):
@@ -134,17 +145,23 @@ class ServeCluster:
                         metric="cluster.latency_s", threshold_s=2.0),
                 SLOSpec(name="goodput", target=0.99, kind="ratio"),
             ))
+            self._statusz_providers.update({
+                "health": self._statusz_health,
+                "status": self._statusz_status,
+                "metrics": self.fleet_metrics})
             self._statusz = StatuszServer(
-                role="driver",
-                providers={"health": self._statusz_health,
-                           "status": self._statusz_status,
-                           "metrics": self.fleet_metrics})
+                role="driver", providers=self._statusz_providers)
             self._statusz.start()
 
         self._tmp = tempfile.TemporaryDirectory(prefix="progen_serve_")
         self.log_dir = Path(log_dir) if log_dir else Path(self._tmp.name)
         self._spec_path = Path(self._tmp.name) / "spec.json"
         self._spec_path.write_text(json.dumps(spec))
+        self._spec_paths = {0: self._spec_path}  # generation -> spec file
+        for i in range(prefill_procs):
+            self._worker_gen[("prefill", i)] = 0
+        for i in range(replicas):
+            self._worker_gen[("decode", i)] = 0
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.bind(("127.0.0.1", 0))
@@ -191,12 +208,18 @@ class ServeCluster:
         # entries still in the router's bookkeeping
         inc = self._incarnations.get((role, idx), 0)
         self._incarnations[(role, idx)] = inc + 1
+        # a worker is pinned to the spec AND generation it was created
+        # under — a respawn during a rolling swap must come back on the
+        # same weights, or its replays would cross generations
+        gen = self._worker_gen.setdefault((role, idx), self.generation)
+        spec_path = self._worker_spec.get(
+            (role, idx), self._spec_paths.get(gen, self._spec_path))
         log_path = self.log_dir / f"{role}_{idx}.log"
         log = open(log_path, "a")
         proc = subprocess.Popen(
             [sys.executable, "-m", "progen_tpu.serve.worker",
-             role, str(idx), str(self.port), str(self._spec_path),
-             str(inc)],
+             role, str(idx), str(self.port), str(spec_path),
+             str(inc), str(gen)],
             env=self._worker_env(), stdout=log, stderr=subprocess.STDOUT,
             cwd=str(_REPO_ROOT))
         log.close()
@@ -242,6 +265,144 @@ class ServeCluster:
         proc = self._procs.get((role, idx))
         if proc is not None and proc.poll() is None:
             os.kill(proc.pid, signal.SIGKILL)
+
+    # ------------------------------------------------------- elastic verbs
+    # The control plane (serve/control.py) mutates fleet membership and
+    # weights through these.  Indices are allocated monotonically and
+    # NEVER reused: batch ids stay unique, supervision budgets stay per
+    # physical instance, and a retired index can't alias a future one.
+
+    def begin_generation(self, spec: dict) -> int:
+        """Register a new weight generation (new checkpoint / LoRA bank
+        in ``spec``); workers spawned afterwards serve it.  Existing
+        workers keep their own generation — the swap is a rolling
+        replace, not an in-place reload."""
+        gen = self.generation + 1
+        path = Path(self._tmp.name) / f"spec_gen{gen}.json"
+        path.write_text(json.dumps(spec))
+        self._spec_paths[gen] = path
+        self.generation = gen
+        self._tracer.event("cluster.generation", generation=gen)
+        return gen
+
+    def add_worker(self, role: str, *, generation: int | None = None,
+                   warm: bool = True) -> int:
+        """Spawn one more stage instance at a fresh index.  The worker
+        is NOT routable until its ready frame arrives (with ``warm``,
+        the spec forces :meth:`ServingEngine.aot_warmup` before ready —
+        warm-before-routable, so scale-up capacity never serves cold).
+        Returns the new index; :meth:`wait_routable` blocks on it."""
+        gen = self.generation if generation is None else int(generation)
+        idx = self._next_idx[role]
+        self._next_idx[role] = idx + 1
+        key = (role, idx)
+        self._worker_gen[key] = gen
+        if warm:
+            base_path = self._spec_paths.get(gen, self._spec_path)
+            warm_path = Path(self._tmp.name) / f"spec_gen{gen}_warm.json"
+            if not warm_path.exists():
+                wspec = json.loads(base_path.read_text())
+                wspec["aot_warmup"] = True
+                warm_path.write_text(json.dumps(wspec))
+            self._worker_spec[key] = warm_path
+        self._pending_routable.add(key)
+        if role == "prefill":
+            self.prefill_procs += 1
+        else:
+            self.replicas += 1
+        self._tracer.event("cluster.scale_up", role=role, idx=idx,
+                           generation=gen)
+        self._spawn(role, idx)
+        return idx
+
+    def wait_routable(self, role: str, idx: int,
+                      timeout: float = 300.0) -> None:
+        """Pump until the scaled-up worker's ready frame made it
+        routable (raises on timeout or if it died before ready without
+        a restart grant)."""
+        key = (role, idx)
+        deadline = time.perf_counter() + timeout
+        while key in self._pending_routable:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"worker {role}:{idx} not routable after {timeout}s"
+                    f"\n--- log tail ---\n{self._log_tail(role, idx)}")
+            proc = self._procs.get(key)
+            if (proc is not None and proc.poll() is not None
+                    and key in self._handled_dead
+                    and key not in self._respawning):
+                raise RuntimeError(
+                    f"worker {role}:{idx} died before ready\n"
+                    f"--- log tail ---\n{self._log_tail(role, idx)}")
+            self._pump(0.1)
+
+    def fence_worker(self, role: str, idx: int) -> None:
+        """Stop routing NEW work to a stage instance; its in-flight
+        work continues (the drain half of retire/swap)."""
+        self.router.fence_worker(role, idx)
+        self._tracer.event("cluster.fence", role=role, idx=idx)
+
+    def retire_worker(self, role: str, idx: int, *,
+                      timeout: float = 120.0) -> None:
+        """Gracefully remove a stage instance with ZERO sheds: fence it,
+        send shutdown (the worker loop finishes every queued request and
+        ships the results before exiting), then wait for its EOF — the
+        dead-peer path sees the planned exit, requeues any leftovers
+        through the replay machinery, and removes it everywhere.  On
+        timeout the worker is killed; its uids still replay."""
+        key = (role, idx)
+        self.router.fence_worker(role, idx)
+        self._tracer.event("cluster.retire", role=role, idx=idx,
+                           generation=self._worker_gen.get(key, 0))
+        if key in self._handled_dead and key not in self._respawning:
+            # already dead with no respawn in flight: nothing to drain
+            self._finalize_retire(role, idx)
+            return
+        self._retiring.add(key)
+        told: set = set()  # peer objects already sent shutdown
+        deadline = time.perf_counter() + timeout
+        killed = False
+        while key in self._retiring:
+            peer = self._peers.get(key)
+            if peer is not None and peer.alive and id(peer) not in told:
+                # covers the initial send AND a respawn that raced the
+                # retire (its fresh peer needs the shutdown too)
+                told.add(id(peer))
+                peer.send_json({"type": "shutdown"})
+            if not killed and time.perf_counter() > deadline:
+                killed = True
+                self.kill_worker(role, idx)
+            elif killed and time.perf_counter() > deadline + 10.0:
+                # no EOF arrived (e.g. the worker never connected):
+                # finalize the bookkeeping directly
+                self._finalize_retire(role, idx)
+                break
+            self._pump(0.05)
+
+    def _finalize_retire(self, role: str, idx: int) -> None:
+        """Remove a retired instance from every bookkeeping structure;
+        any uids it still held replay through the normal path (typed
+        sheds only if the whole stage is gone)."""
+        key = (role, idx)
+        self._retiring.discard(key)
+        self._pending_routable.discard(key)
+        if role == "decode":
+            for bid in self.router.unacked_batches(idx):
+                self._return_credit(bid)
+        affected = self.router.fail_worker(role, idx)
+        self.router.retire_worker(role, idx)
+        self.supervisor.forget(role, idx)
+        self._worker_spec.pop(key, None)
+        self._worker_gen.pop(key, None)
+        if role == "prefill":
+            self.prefill_procs -= 1
+        else:
+            self.replicas -= 1
+        self._tracer.event("cluster.retired", role=role, idx=idx,
+                           replayed=len(affected))
+        now = time.perf_counter()
+        for uid in affected:
+            self._dispatch(uid, now)
 
     # -------------------------------------------------------------- frontend
 
@@ -298,6 +459,7 @@ class ServeCluster:
         if not self.router.complete(uid):
             return
         comp = _shed_completion(request, status, now)
+        comp.generation = self.router.generation_of(uid)
         self.completions[uid] = comp
         self._new.append(comp)
         self._shed_ctr.inc()
@@ -315,13 +477,25 @@ class ServeCluster:
 
     def drain(self, timeout: float = 600.0) -> list[Completion]:
         """Block until every submitted request has completed (served or
-        typed-shed); returns ALL completions sorted by uid."""
+        typed-shed); returns ALL completions sorted by uid.
+
+        ``timeout`` is a hard bound: past it every still-open request is
+        answered with a typed ``DRAIN_TIMEOUT`` completion instead of
+        raising — a wedged worker can no longer stall drain (and thus
+        retire/scale-down, which requires bounded drain) forever.  The
+        exactly-once contract holds: a late real completion for a
+        timed-out uid is dropped by the router's dedup."""
         deadline = time.perf_counter() + timeout
         while self.pending > 0:
             if time.perf_counter() > deadline:
-                raise RuntimeError(
-                    f"cluster drain timed out with {self.pending} "
-                    f"request(s) open; router={self.router.stats()}")
+                now = time.perf_counter()
+                stuck = [uid for uid in self.router.requests
+                         if uid not in self.router.completed]
+                for uid in stuck:
+                    self._shed(uid, DRAIN_TIMEOUT, now)
+                self._tracer.event("cluster.drain_timeout",
+                                   timeout_s=timeout, shed=len(stuck))
+                break
             self._pump(0.1)
         # freshness flush: ask every live worker for a stats/metrics
         # frame NOW, so post-drain stats() reflects the drained state
@@ -376,6 +550,21 @@ class ServeCluster:
             # staleness starts here: until ready, the worker is inside
             # its engine build (cold jit can run minutes heartbeat-free)
             peer.ready = True
+            key = (peer.role, peer.index)
+            if key in self._pending_routable:
+                # warm-before-routable: a scaled-up worker joins the
+                # routable set only now — its compiles are behind it
+                self._pending_routable.discard(key)
+                self.router.add_worker(
+                    peer.role, peer.index,
+                    self._worker_gen.get(key, 0))
+                self._tracer.event(
+                    "cluster.routable", role=peer.role, idx=peer.index,
+                    generation=self._worker_gen.get(key, 0))
+                parked, self._parked_uids = self._parked_uids, []
+                now = time.perf_counter()
+                for uid in parked:
+                    self._dispatch(uid, now)
         elif t == "handle":
             self._on_handle(peer, header, frame)
         elif t == "ack":
@@ -394,6 +583,10 @@ class ServeCluster:
                 now = time.perf_counter()
                 submit = self.router.submit_times.get(uid, 0.0)
                 comp = _completion_from_wire(header, submit, now)
+                # a uid's generation is the one that PRIMED it (router
+                # bookkeeping), not whatever the cluster serves now —
+                # in-flight requests finish on their own generation
+                comp.generation = self.router.generation_of(uid)
                 self.completions[uid] = comp
                 self._new.append(comp)
                 # the one end-to-end latency code path: the same
@@ -421,18 +614,24 @@ class ServeCluster:
             self._statusz_ports[(role, idx)] = header["statusz_port"]
         # a dead-but-not-yet-restarted stage is visible here before the
         # supervisor acts: up{role,idx} flips 0 in _on_peer_dead and back
-        # to 1 on the respawn's hello
+        # to 1 on the respawn's hello — mirrored as a tracer event so
+        # fleet-membership transitions land on the merged timeline
         _metrics.get_registry().gauge(
             _metrics.labeled("cluster.up", role=role, idx=idx)).set(1.0)
+        self._tracer.event("cluster.up", role=role, idx=idx, up=1,
+                           generation=header.get("generation", 0))
         self._note_clock(role, idx, header.get("clock"))
         if (role, idx) in self._respawning:
             self._respawning.discard((role, idx))
             self._handled_dead.discard((role, idx))
-            self.router.revive_worker(role, idx)
-            parked, self._parked_uids = self._parked_uids, []
-            now = time.perf_counter()
-            for uid in parked:
-                self._dispatch(uid, now)
+            if (role, idx) not in self._pending_routable:
+                # a pre-ready scale-up respawn stays out of the routable
+                # set until its own ready frame (warm-before-routable)
+                self.router.revive_worker(role, idx)
+                parked, self._parked_uids = self._parked_uids, []
+                now = time.perf_counter()
+                for uid in parked:
+                    self._dispatch(uid, now)
 
     def _note_clock(self, role, idx, clock) -> None:
         """Refine the (role, idx) worker's perf_counter offset from a
@@ -468,7 +667,9 @@ class ServeCluster:
         batch_id = header.get("batch_id")
         uids = [d["uid"] for d in header.get("reqs", [])]
         self.router.note_handle(batch_id, uids, peer.index)
-        r = self.router.pick_replica()
+        # per-generation placement: state primed on gen-G weights may
+        # only decode on a gen-G replica (swap correctness/determinism)
+        r = self.router.pick_replica(self.router.batch_generation(batch_id))
         if r is None:
             # this batch will never reach replica admission: return its
             # credit before parking/shedding the member requests
@@ -499,12 +700,20 @@ class ServeCluster:
         _metrics.get_registry().gauge(
             _metrics.labeled("cluster.up", role=peer.role,
                              idx=peer.index)).set(0.0)
+        self._tracer.event("cluster.up", role=peer.role, idx=peer.index,
+                           up=0, reason=reason)
         proc = self._procs.get(key)
         if proc is not None and proc.poll() is None:
             proc.kill()
         peer.close()
         if self._peers.get(key) is peer:
             del self._peers[key]
+
+        if key in self._retiring:
+            # planned exit (retire/scale-down/swap): not a failure — no
+            # restart budget burned, no respawn; leftovers replay
+            self._finalize_retire(peer.role, peer.index)
+            return
 
         if peer.role == "decode":
             # batches forwarded to the dead replica but never admitted:
@@ -620,6 +829,12 @@ class ServeCluster:
 
     # ------------------------------------------------------------- statusz
 
+    def register_statusz_provider(self, name: str, fn) -> None:
+        """Expose an extra provider on the driver's statusz server (the
+        control plane registers ``control`` here for ``/controlz``).
+        No-op when the introspection plane is off."""
+        self._statusz_providers[name] = fn
+
     def fleet_metrics(self) -> dict:
         """Fleet-merged registry snapshot: the driver's own registry plus
         the freshest per-worker snapshot (final stats frame or heartbeat,
@@ -703,7 +918,13 @@ class ServeCluster:
             statusz_ports[f"{role}:{idx}"] = p
         return {
             "topology": {"prefill_procs": self.prefill_procs,
-                         "replicas": self.replicas},
+                         "replicas": self.replicas,
+                         "generation": self.generation,
+                         "retiring": sorted(
+                             f"{r}:{i}" for r, i in self._retiring),
+                         "pending_routable": sorted(
+                             f"{r}:{i}"
+                             for r, i in self._pending_routable)},
             **({"statusz_ports": statusz_ports} if statusz_ports else {}),
             "router": self.router.stats(),
             "router_transport": self.counters.as_dict(),

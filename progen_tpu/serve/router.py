@@ -17,6 +17,15 @@ Policy:
   a dead stage maps to exactly the uids whose work it held:
   :meth:`fail_worker` returns them for replay (seed determinism makes
   replays token-identical) or typed shedding — never an exception.
+
+The control plane (``serve/control.py``) mutates the routable set at
+runtime: :meth:`add_worker` grows a stage, :meth:`fence_worker` stops
+new placements without touching in-flight bookkeeping (drain), and
+:meth:`retire_worker` removes a fully drained instance.  Every worker
+carries a weight **generation**; a uid is stamped with the generation
+of the prefill worker that primed it and its handle may only decode on
+a replica of the same generation, so per-generation determinism holds
+across a rolling weight swap.
 """
 
 from __future__ import annotations
@@ -31,12 +40,17 @@ class Router:
                              "replica")
         self.prefill_alive = set(range(prefill_workers))
         self.replica_alive = set(range(replicas))
+        self.prefill_fenced: set = set()  # alive but not placeable (draining)
+        self.replica_fenced: set = set()
+        self.prefill_gen = {w: 0 for w in range(prefill_workers)}
+        self.replica_gen = {r: 0 for r in range(replicas)}
         self.prefill_load = {w: 0 for w in range(prefill_workers)}
         self.outstanding = {r: 0 for r in range(replicas)}
         self.requests: dict = {}          # uid -> Request
         self.stage: dict = {}             # uid -> ("prefill"|"handle"|"replica", key)
-        self.batches: dict = {}           # batch_id -> {uids, src, replica, acked, open}
+        self.batches: dict = {}           # batch_id -> {uids, src, replica, acked, open, gen}
         self._uid_batch: dict = {}        # uid -> batch_id it last rode in
+        self.uid_gen: dict = {}           # uid -> generation that primed it
         self.completed: set = set()
         self.submit_times: dict = {}      # uid -> router perf_counter instant
         self.max_prefill_queue = 0
@@ -44,20 +58,32 @@ class Router:
 
     # ------------------------------------------------------------- placement
 
-    def pick_prefill(self) -> int | None:
-        """Least queued-requests live prefill worker; None when the
-        whole stage is down (caller sheds)."""
-        if not self.prefill_alive:
-            return None
-        return min(sorted(self.prefill_alive),
-                   key=lambda w: self.prefill_load[w])
+    def _placeable_prefill(self) -> set:
+        return self.prefill_alive - self.prefill_fenced
 
-    def pick_replica(self) -> int | None:
-        """Least-outstanding-tokens live replica."""
-        if not self.replica_alive:
+    def _placeable_replicas(self) -> set:
+        return self.replica_alive - self.replica_fenced
+
+    def pick_prefill(self) -> int | None:
+        """Least queued-requests live, unfenced prefill worker; None
+        when the whole stage is down or fenced (caller sheds/parks)."""
+        live = self._placeable_prefill()
+        if not live:
             return None
-        return min(sorted(self.replica_alive),
-                   key=lambda r: self.outstanding[r])
+        return min(sorted(live), key=lambda w: self.prefill_load[w])
+
+    def pick_replica(self, generation: int | None = None) -> int | None:
+        """Least-outstanding-tokens live, unfenced replica.  With
+        ``generation`` set, only replicas serving that weight generation
+        qualify — a handle primed on gen-G weights must decode on gen-G
+        weights or determinism (and the swap contract) breaks."""
+        live = self._placeable_replicas()
+        if generation is not None:
+            live = {r for r in live
+                    if self.replica_gen.get(r, 0) == generation}
+        if not live:
+            return None
+        return min(sorted(live), key=lambda r: self.outstanding[r])
 
     # ------------------------------------------------------------- lifecycle
 
@@ -65,6 +91,7 @@ class Router:
         self.requests[uid] = request
         self.submit_times.setdefault(uid, now)
         self.stage[uid] = ("prefill", worker)
+        self.uid_gen[uid] = self.prefill_gen.get(worker, 0)
         self.prefill_load[worker] += 1
         self.max_prefill_queue = max(self.max_prefill_queue,
                                      self.prefill_load[worker])
@@ -76,7 +103,8 @@ class Router:
         then it is pruned, so long-running clusters don't grow."""
         self.batches[batch_id] = {"uids": list(uids), "src": src,
                                   "replica": None, "acked": False,
-                                  "open": set(uids)}
+                                  "open": set(uids),
+                                  "gen": self.prefill_gen.get(src, 0)}
         for uid in uids:
             self._uid_batch[uid] = batch_id
             if self.stage.get(uid, (None,))[0] == "prefill":
@@ -142,9 +170,9 @@ class Router:
             return False
         self.completed.add(uid)
         kind, key = self.stage.pop(uid, (None, None))
-        if kind == "prefill":
+        if kind == "prefill" and key in self.prefill_load:
             self.prefill_load[key] = max(0, self.prefill_load[key] - 1)
-        elif kind == "replica":
+        elif kind == "replica" and key in self.outstanding:
             r = self.requests[uid]
             self.outstanding[key] = max(
                 0, self.outstanding[key] - int(r.max_new_tokens))
@@ -159,15 +187,80 @@ class Router:
             if uid in self.completed or uid not in self.requests:
                 continue
             kind, key = self.stage.pop(uid, (None, None))
-            if kind == "prefill":
+            if kind == "prefill" and key in self.prefill_load:
                 self.prefill_load[key] = max(0, self.prefill_load[key] - 1)
-            elif kind == "replica":
+            elif kind == "replica" and key in self.outstanding:
                 r = self.requests[uid]
                 self.outstanding[key] = max(
                     0, self.outstanding[key] - int(r.max_new_tokens))
             self._leave_batch(uid)
             out.append(uid)
         return out
+
+    # ------------------------------------------------------------ membership
+
+    def add_worker(self, role: str, index: int, generation: int = 0) -> None:
+        """Grow a stage: ``index`` becomes alive + placeable serving
+        weight ``generation``.  Idempotent for an already-known index
+        (resets its load and unfences it)."""
+        if role == "prefill":
+            self.prefill_alive.add(index)
+            self.prefill_fenced.discard(index)
+            self.prefill_gen[index] = generation
+            self.prefill_load[index] = 0
+        else:
+            self.replica_alive.add(index)
+            self.replica_fenced.discard(index)
+            self.replica_gen[index] = generation
+            self.outstanding[index] = 0
+
+    def fence_worker(self, role: str, index: int) -> None:
+        """Stop new placements on ``index`` without disturbing its
+        in-flight bookkeeping — the drain half of retire/swap."""
+        if role == "prefill":
+            self.prefill_fenced.add(index)
+        else:
+            self.replica_fenced.add(index)
+
+    def retire_worker(self, role: str, index: int) -> None:
+        """Remove a drained instance entirely: not alive, not fenced,
+        no load entry.  A retired index is never reused (the cluster
+        allocates monotonically), so stale batch ids can't collide."""
+        if role == "prefill":
+            self.prefill_alive.discard(index)
+            self.prefill_fenced.discard(index)
+            self.prefill_gen.pop(index, None)
+            self.prefill_load.pop(index, None)
+        else:
+            self.replica_alive.discard(index)
+            self.replica_fenced.discard(index)
+            self.replica_gen.pop(index, None)
+            self.outstanding.pop(index, None)
+
+    def generation_of(self, uid) -> int:
+        """Weight generation of the prefill pass that primed ``uid``
+        (0 until it has been assigned)."""
+        return self.uid_gen.get(uid, 0)
+
+    def batch_generation(self, batch_id: str) -> int:
+        b = self.batches.get(batch_id)
+        return 0 if b is None else b.get("gen", 0)
+
+    def uids_on(self, role: str, index: int) -> list:
+        """Uncompleted uids whose current stage is ``(role, index)``
+        (for prefill: queued on the worker; for decode: decoding on the
+        replica).  Handle-stage uids belong to neither until forwarded."""
+        kind = "prefill" if role == "prefill" else "replica"
+        return [uid for uid, (k, key) in self.stage.items()
+                if k == kind and key == index and uid not in self.completed]
+
+    def generation_in_flight(self, generation: int) -> int:
+        """How many submitted-but-uncompleted uids were primed on
+        ``generation`` — the swap waits for this to hit zero before
+        retiring that generation's replicas."""
+        return sum(1 for uid in self.stage
+                   if uid not in self.completed
+                   and self.uid_gen.get(uid, 0) == generation)
 
     # --------------------------------------------------------------- failure
 
@@ -187,7 +280,8 @@ class Router:
             for uid, (kind, key) in self.stage.items():
                 if kind == "replica" and key == index:
                     affected.append(uid)
-            self.outstanding[index] = 0
+            if index in self.outstanding:
+                self.outstanding[index] = 0
         return self.requeue(affected)
 
     def revive_worker(self, role: str, index: int) -> None:
@@ -204,6 +298,10 @@ class Router:
         return {
             "prefill_alive": sorted(self.prefill_alive),
             "replica_alive": sorted(self.replica_alive),
+            "prefill_fenced": sorted(self.prefill_fenced),
+            "replica_fenced": sorted(self.replica_fenced),
+            "prefill_gen": dict(self.prefill_gen),
+            "replica_gen": dict(self.replica_gen),
             "prefill_load": dict(self.prefill_load),
             "outstanding_tokens": dict(self.outstanding),
             "max_prefill_queue": self.max_prefill_queue,

@@ -42,7 +42,9 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
               checkpoint_path: str | None = None, draft: str = "identity",
               engine: dict | None = None, draft_config=None,
               heartbeat_s: float = 1.0, trace: dict | None = None,
-              statusz: bool = False) -> dict:
+              statusz: bool = False, lora: dict | None = None,
+              aot_warmup: bool = False,
+              warmup_max_prime: int | None = None) -> dict:
     """Build the JSON-able worker spec.  ``engine`` holds
     :class:`ServingEngine` kwargs (slots/chunk/paged/spec/...);
     ``disagg`` is implied.  Params come from ``checkpoint_path`` when
@@ -53,7 +55,17 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
     (docs/OBSERVABILITY.md).  ``statusz=True`` starts a loopback
     introspection server in every process (driver included) on an
     ephemeral port; workers report their port in the hello frame and the
-    driver surfaces the map on its own /statusz."""
+    driver surfaces the map on its own /statusz.
+
+    ``lora`` (``{"tenants": T, "rank": R, "seed"?, "scale"?}``) gives the
+    worker a deterministic adapter bank built with
+    :func:`~progen_tpu.workloads.lora.random_lora_bank` — bit-identical
+    in every process, so multi-tenant handles merge into any replica of
+    the same spec.  ``aot_warmup=True`` makes the worker compile its
+    whole program grid BEFORE sending its ready frame (warm-before-
+    routable: the control plane only routes to workers that answered
+    ready, so a scaled-up worker never eats cold compiles on live
+    traffic); ``warmup_max_prime`` caps the bucket sweep."""
     spec = {
         "config": config.to_dict(),
         "mixed_precision": bool(mixed_precision),
@@ -67,6 +79,12 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
         spec["trace"] = dict(trace)
     if statusz:
         spec["statusz"] = True
+    if lora:
+        spec["lora"] = dict(lora)
+    if aot_warmup:
+        spec["aot_warmup"] = True
+        if warmup_max_prime is not None:
+            spec["warmup_max_prime"] = int(warmup_max_prime)
     if draft_config is not None:
         spec["draft_config"] = draft_config.to_dict()
     return spec
@@ -102,6 +120,16 @@ def build_engine_from_spec(spec: dict, *, remote_prefill: bool = False):
     kw["disagg"] = True
     if kw.get("spec") and "draft_config" in spec:
         kw["draft_config"] = ProGenConfig.from_dict(spec["draft_config"])
+    if spec.get("lora"):
+        # spec-driven bank: random_lora_bank is deterministic per seed,
+        # so every process rebuilds the SAME adapters (like init params)
+        from progen_tpu.workloads.lora import random_lora_bank
+
+        lcfg = spec["lora"]
+        kw["lora_bank"] = random_lora_bank(
+            cfg, int(lcfg["tenants"]), int(lcfg["rank"]),
+            seed=int(lcfg.get("seed", 0)),
+            scale=float(lcfg.get("scale", 1e-2)))
     return ServingEngine(cfg, params, policy=policy,
                          remote_prefill=remote_prefill, **kw)
 
@@ -154,7 +182,8 @@ def _stats_frame(eng, counters, **extra) -> dict:
 
 
 def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
-                  window: int, incarnation: int = 0) -> None:
+                  window: int, incarnation: int = 0,
+                  generation: int = 0) -> None:
     from progen_tpu.decode.handoff import (
         request_from_wire,
         serialize_handle,
@@ -218,6 +247,7 @@ def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
                     h, counters=counters,
                     extra_header={"batch_id": batch_id,
                                   "src": peer.index,
+                                  "generation": generation,
                                   "trace_ctx": {
                                       "clock": time.perf_counter(),
                                       "src_proc": f"prefill:{peer.index}"}})
@@ -310,6 +340,7 @@ def main(argv) -> int:
     role, index, port, spec_path = (
         argv[0], int(argv[1]), int(argv[2]), argv[3])
     incarnation = int(argv[4]) if len(argv) > 4 else 0
+    generation = int(argv[5]) if len(argv) > 5 else 0
     from progen_tpu.core.cache import enable_compilation_cache
 
     enable_compilation_cache()
@@ -365,6 +396,7 @@ def main(argv) -> int:
     # the clock echo lets the driver estimate this process's perf_counter
     # offset, so merged trace timelines are causally ordered
     hello = {"type": "hello", "role": role, "index": index,
+             "generation": generation,
              "clock": time.perf_counter()}
     if statusz_srv is not None:
         hello["statusz_port"] = statusz_srv.port
@@ -374,11 +406,22 @@ def main(argv) -> int:
     holder["phase"] = "building"
     t0 = time.perf_counter()
     eng = build_engine_from_spec(spec, remote_prefill=(role == "decode"))
+    eng.generation = generation
+    warm = {}
+    if spec.get("aot_warmup"):
+        # warm-before-routable: the ready frame is what makes a
+        # scaled-up worker placeable, so every compile lands before it
+        holder["phase"] = "warming"
+        warm = eng.aot_warmup(max_prime=spec.get("warmup_max_prime"))
     print(f"worker {role}:{index} engine ready in "
           f"{time.perf_counter() - t0:.1f}s", flush=True)
     holder["eng"] = eng
     holder["phase"] = "serving"
-    peer.send_json({"type": "ready", "build_s": time.perf_counter() - t0})
+    ready = {"type": "ready", "build_s": time.perf_counter() - t0,
+             "generation": generation}
+    if warm:
+        ready["warmup"] = warm
+    peer.send_json(ready)
 
     inbox: _queue.Queue = _queue.Queue()
     peer.start_reader(inbox)
@@ -387,7 +430,7 @@ def main(argv) -> int:
         window = max(1, int(spec.get("engine", {}).get("handoff_depth", 2)))
         _prefill_loop(eng, peer, inbox, counters,
                       heartbeat_s=hb, window=window,
-                      incarnation=incarnation)
+                      incarnation=incarnation, generation=generation)
     else:
         _decode_loop(eng, peer, inbox, counters, heartbeat_s=hb)
     if tcfg and tcfg.get("dir"):

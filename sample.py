@@ -96,6 +96,28 @@ honor_env_platforms()
               help="prefill worker processes (with --serve_procs)")
 @click.option("--replicas", default=1,
               help="decode replica processes (with --serve_procs)")
+@click.option("--autoscale", is_flag=True,
+              help="with --serve_procs: run the elastic control plane — "
+                   "scale the fleet between the min/max bounds on SLO "
+                   "burn rate and queue depth; decisions are journaled "
+                   "and printed (docs/SERVING.md §9)")
+@click.option("--min_prefill", default=None, type=int,
+              help="autoscale floor for prefill workers "
+                   "(default: --prefill_procs)")
+@click.option("--max_prefill", default=None, type=int,
+              help="autoscale ceiling for prefill workers "
+                   "(default: --prefill_procs + 2)")
+@click.option("--min_replicas", default=None, type=int,
+              help="autoscale floor for decode replicas "
+                   "(default: --replicas)")
+@click.option("--max_replicas", default=None, type=int,
+              help="autoscale ceiling for decode replicas "
+                   "(default: --replicas + 2)")
+@click.option("--swap_at", default=None, type=int,
+              help="with --serve_procs: after N completions, hot-swap "
+                   "weights with a zero-downtime rolling worker upgrade "
+                   "(new generation of the same checkpoint) — no request "
+                   "is dropped; completions report their generation")
 @click.option("--watchdog_timeout", default=None, type=float,
               help="engine: seconds without a completed serve step before "
                    "the watchdog dumps all-thread stacks to CWD and exits "
@@ -123,7 +145,8 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
          seq_len, mesh_spec, strategies, serve, embed_mode, infill, slots,
          chunk, paged, page_size, serve_attempts, snapshot_path, aot_warmup,
          spec, spec_k, disagg, serve_procs, prefill_procs, replicas,
-         watchdog_timeout, statusz, trace, trace_out, xprof_dir,
+         autoscale, min_prefill, max_prefill, min_replicas, max_replicas,
+         swap_at, watchdog_timeout, statusz, trace, trace_out, xprof_dir,
          compile_cache):
     import os
 
@@ -272,6 +295,15 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                 statusz=statusz)
             cluster = ServeCluster(wspec, prefill_procs=prefill_procs,
                                    replicas=replicas)
+            control = None
+            if autoscale or swap_at is not None:
+                from progen_tpu.serve import BurnRatePolicy, ControlPlane
+
+                control = ControlPlane(cluster, BurnRatePolicy(
+                    min_prefill=min_prefill or prefill_procs,
+                    max_prefill=max_prefill or prefill_procs + 2,
+                    min_replicas=min_replicas or replicas,
+                    max_replicas=max_replicas or replicas + 2))
             if statusz:
                 ports = cluster.stats().get("statusz_ports", {})
                 for who, p in sorted(ports.items()):
@@ -283,8 +315,31 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                             cluster.submit_embed(r)
                         else:
                             cluster.submit(r)
-                    completions = cluster.drain()
+                    if control is None:
+                        completions = cluster.drain()
+                    else:
+                        # drive loop with control ticks between polls:
+                        # the autoscaler acts on live burn/queue signals
+                        # and --swap_at rolls the fleet mid-stream
+                        completions = []
+                        swapped = False
+                        while cluster.pending:
+                            completions.extend(cluster.poll(timeout=0.2))
+                            if (swap_at is not None and not swapped
+                                    and len(completions) >= swap_at):
+                                swapped = True
+                                gen = control.swap_weights()
+                                print(f"swap: rolled fleet to "
+                                      f"generation {gen}")
+                            if autoscale:
+                                control.tick()
             finally:
+                if control is not None:
+                    for e in control.journal:
+                        if e["event"] in ("scale_up", "scale_down"):
+                            print(f"autoscale: {e['event']} {e['role']} "
+                                  f"(cause={e['cause']}, "
+                                  f"observed={e['observed']})")
                 cluster.shutdown()
             if trace:
                 merged = merge_trace_dir(trace_out)

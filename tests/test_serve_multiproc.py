@@ -327,6 +327,14 @@ def _bare_cluster(prefill=1, replicas=1, max_restarts=0):
     c._handled_dead, c._respawning = set(), set()
     c._parked_uids, c._worker_stats, c._hb = [], {}, {}
     c._stats_age, c._clock_offsets = {}, {}
+    c.generation = 0
+    c._worker_gen = {("prefill", i): 0 for i in range(prefill)}
+    c._worker_gen.update({("decode", i): 0 for i in range(replicas)})
+    c._worker_spec = {}
+    c._retiring, c._pending_routable = set(), set()
+    c._next_idx = {"prefill": prefill, "decode": replicas}
+    c._spec_paths = {}
+    c._statusz_providers = {}
     from progen_tpu.observe import metrics as _metrics
     from progen_tpu.observe import trace as _trace
     c._tracer = _trace.get_tracer()
@@ -441,7 +449,10 @@ def test_spawn_passes_incarnation_nonce(monkeypatch, tmp_path):
     ServeCluster._spawn(c, "prefill", 0)
     ServeCluster._spawn(c, "prefill", 0)             # the respawn
     ServeCluster._spawn(c, "decode", 0)              # independent counter
-    assert [cmd[-1] for cmd in cmds] == ["0", "1", "0"]
+    # argv tail is (incarnation, generation); the respawn bumps the
+    # nonce but stays pinned to the generation it was created under
+    assert [cmd[-2] for cmd in cmds] == ["0", "1", "0"]
+    assert [cmd[-1] for cmd in cmds] == ["0", "0", "0"]
     assert c._incarnations == {("prefill", 0): 2, ("decode", 0): 1}
 
 

@@ -392,8 +392,11 @@ def test_workload_validation_errors(params, bank):
         eng.submit_embed(Request(uid="x", tokens=[], max_new_tokens=1))
     with pytest.raises(ValueError):   # lora composes with paged, not spec
         mk_engine(params, lora_bank=bank, spec=True)
-    with pytest.raises(ValueError):   # ...nor single-process disagg
-        mk_engine(params, lora_bank=bank, disagg=True)
+    # ...but DOES compose with disaggregated decode: the handle carries a
+    # tenant leaf, and the rolling hot-swap path (docs/SERVING.md §9) ships
+    # banks to disaggregated workers
+    eng = mk_engine(params, lora_bank=bank, disagg=True)
+    assert eng.disagg and eng.lora and eng.num_tenants > 1
 
 
 # ---------------------------------------------------------- lora training

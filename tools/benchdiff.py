@@ -46,6 +46,11 @@ WATCHED: dict[str, tuple[int, float]] = {
     "p95_latency_s": (-1, 0.40),
     "wall_s": (-1, 0.40),
     "slo_burn_rate": (-1, 0.50),
+    # elastic control plane (bench_elastic.py): sheds under burst with
+    # autoscale on, and requests dropped inside the swap window (a
+    # zero baseline makes ANY dropped request a regression)
+    "shed_rate": (-1, 0.50),
+    "swap_dropped": (-1, 0.50),
 }
 
 

@@ -105,6 +105,23 @@ if python tools/benchdiff.py "$BENCH_DIR/base.jsonl" "$BENCH_DIR/bad.jsonl"; the
     exit 1
 fi
 
+echo "== elastic-serving smoke =="
+# elastic control plane on a real cluster: a bursty schedule forces a
+# scale-up (warm-before-routable), plus a rolling LoRA hot-swap mid-run;
+# --verify asserts every non-shed completion is token-identical to the
+# max-size fixed fleet and the swap window dropped zero requests
+# (docs/SERVING.md §9).  fixed_small is skipped: the verify oracle is
+# fixed_big, and the autoscale + swap phases are the paths under test.
+JAX_PLATFORMS=cpu python benchmarks/bench_elastic.py \
+    --config default --requests 8 --rate 4 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 4 --prime-max 12 \
+    --swap-at 2 --swap-requests 6 --skip-modes fixed_small \
+    --verify --out "$BENCH_DIR/elastic.jsonl"
+# self-diff on the elastic record must pass (same gate family as the
+# quick-bench: shed_rate and swap_dropped are watched fields)
+python tools/benchdiff.py --metric serving_elastic \
+    "$BENCH_DIR/elastic.jsonl" "$BENCH_DIR/elastic.jsonl"
+
 echo "== scenario-mix smoke =="
 # all four workload classes (generate / constrained infill / embeddings /
 # multi-tenant LoRA) through ONE engine run with --verify: asserts rerun
