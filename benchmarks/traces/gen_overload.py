@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Regenerate ``benchmarks/traces/overload_2x.jsonl`` — the committed
+2x-overload QoS trace ``tools/check.sh`` replays with ``--verify``.
+
+The trace is data, not code: a header line fixing the virtual clock
+(``step_dt``), the tenant weight map and the admission bound, then one
+arrival per line.  Replayed with the check.sh knobs (2 slots, chunk 4,
+max_new 6) the offered load is ~2 requests per virtual second against
+~1 request/second of service capacity, so the queue builds, shed-oldest
+fires, and high-priority arrivals preempt low-priority in-flight work —
+every one of those events deterministic because the replay runs on
+virtual time (bench_serving.py ``--trace-file``).
+
+Shape choices, all deliberate:
+
+* 16 arrivals at 0.5-virtual-second spacing (2x overload).
+* every 4th request is priority 2 (~25% high class) — enough traffic
+  for a meaningful p95, few enough that preemption is the exception.
+* low-priority requests generate 10 tokens (3 chunks at chunk=4), the
+  high class 6 — long-running background work holds both slots across
+  high-priority arrivals, so preemption actually fires instead of the
+  high class merely jumping the queue.
+* tenants cycle 0/1/2 with weights 1/2/1 — tenant 1 is entitled to half
+  the service, so DWRR visibly diverges from round-robin.
+* uids 5 and 10 carry ``ttl: 0.0`` — against the virtual clock they are
+  already expired at submit, so ``shed_deadline`` appears in the record
+  deterministically (no timing race).
+* every 5th request reuses prime_seed 1000 at length 8 — a Zipf-style
+  hot prompt that exercises the prefix cache under ``--paged``.
+
+Primes are regenerated from ``(prime_seed, prime_len)`` at replay, so
+the file is vocabulary-agnostic.  Rerunning this script reproduces the
+committed file byte-for-byte.
+"""
+
+import json
+import os
+
+N = 16
+HEADER = {
+    "kind": "qos_trace",
+    "version": 1,
+    "name": "overload_2x",
+    "step_dt": 1.0,
+    "max_new": 6,
+    "weights": {"0": 1.0, "1": 2.0, "2": 1.0},
+    "max_queue": 6,
+    "shed_policy": "shed-oldest",
+}
+
+# prime lengths cycle through the ragged prefill buckets; the hot
+# prompt (every 5th uid) pins both seed and length
+LENS = [4, 6, 8, 10, 12, 6, 8, 10]
+
+
+def entry(uid: int) -> dict:
+    hot = uid % 5 == 0
+    hi = uid % 4 == 3
+    e = {
+        "uid": uid,
+        "at": round(0.5 * uid, 2),
+        "prime_seed": 1000 if hot else 1000 + uid,
+        "prime_len": 8 if hot else LENS[uid % len(LENS)],
+        "priority": 2 if hi else 0,
+        "tenant": uid % 3,
+        "max_new": 6 if hi else 10,
+        "seed": 100 + uid,
+    }
+    if uid in (5, 10):
+        e["ttl"] = 0.0
+    return e
+
+
+def main() -> None:
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "overload_2x.jsonl")
+    with open(out, "w") as f:
+        f.write(json.dumps(HEADER) + "\n")
+        for uid in range(N):
+            f.write(json.dumps(entry(uid)) + "\n")
+    print(f"wrote {out}: {N} arrivals")
+
+
+if __name__ == "__main__":
+    main()

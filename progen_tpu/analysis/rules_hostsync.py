@@ -63,7 +63,8 @@ HOT_ZONES: tuple[Zone, ...] = (
         r"|_admit_from_handoff|_prefill_worker_call|_merge_call"
         r"|admit_handle|run_prefill_round|drain_sheds|_note_stage"
         r"|submit_embed|_embed_round|run_embed_round|embed_pending"
-        r"|_build_lmask|status)$",
+        r"|_build_lmask|status|_maybe_preempt|_preempt_slot|qos_status"
+        r"|_publish_qos_gauges)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
@@ -75,7 +76,8 @@ HOT_ZONES: tuple[Zone, ...] = (
                    "paged_impl", "_watchdog", "_handoff", "disagg",
                    "spec", "spec_k", "prefill_batch", "_max_advance",
                    "_spec_rounds", "remote_prefill", "stage_seconds",
-                   "_tracer", "_stage_hist", "_embed_queue", "lora"}),
+                   "_tracer", "_stage_hist", "_embed_queue", "lora",
+                   "qos_weights", "_qos_gauge_keys"}),
         # requests, admission rows and snapshots are host payloads by API
         # contract: numpy masks, python ints, JSON-safe dicts — never
         # device arrays
@@ -84,6 +86,18 @@ HOT_ZONES: tuple[Zone, ...] = (
     # the page pool is pure host bookkeeping between dispatches: nothing
     # in it may touch a device value, so every sync call is a finding
     Zone(r"decode/paging\.py$", r"PagePool\..*$"),
+    # the QoS scheduler runs between every admission decision: pure host
+    # bookkeeping over Request metadata (priority/tenant/deadline are
+    # python scalars by API contract), a sync here stalls every step.
+    # __init__ is deliberately unzoned — weight validation is one-time
+    Zone(r"decode/qos\.py$",
+         r"(QoSQueue\.(append|appendleft|popleft|_peek|_select"
+         r"|_note_served|shed_victim|remove|stats|__len__|__bool__"
+         r"|__iter__|__getitem__)|_deadline_key)$",
+         frozenset({"_weights", "_front", "_classes", "_deficit",
+                    "_rr_at", "_rr_charged", "_seq", "_len",
+                    "served_by_class", "served_by_tenant"}),
+         frozenset({"r"})),
     # the handoff queue carries device arrays inside handles but is pure
     # host bookkeeping itself — any sync in it would sit on the step path
     # (module-level serialize_handle/deserialize_handle are TRANSPORT and
@@ -95,7 +109,8 @@ HOT_ZONES: tuple[Zone, ...] = (
     # host bookkeeping, any sync would serialize the whole cluster
     Zone(r"serve/router\.py$", r"Router\..*$",
          frozenset({"prefill_alive", "replica_alive", "prefill_load",
-                    "outstanding", "requests", "stage", "batches",
+                    "prefill_class_load", "outstanding", "requests",
+                    "stage", "batches",
                     "_uid_batch", "completed", "submit_times",
                     "max_prefill_queue", "max_outstanding",
                     "prefill_fenced", "replica_fenced",
@@ -243,9 +258,11 @@ class _HostSafe:
             name = call_name(node)
             if name == "jax.device_get":
                 return True
-            if name and (name.startswith("np.") or name.startswith("numpy.")):
+            if name and (name.startswith("np.") or name.startswith("numpy.")
+                         or name.startswith("math.")):
                 return all(self._host_value(a) for a in node.args)
-            if name in ("len", "range", "enumerate", "zip", "min", "max", "sum"):
+            if name in ("len", "range", "enumerate", "zip", "min", "max",
+                        "sum", "sorted", "getattr"):
                 return all(self._host_value(a) for a in node.args)
             if name in _CAST_CALLS:
                 return all(self._host_value(a) for a in node.args)
@@ -277,7 +294,8 @@ class _HostSafe:
             )
         if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
             return all(self._host_value(e) for e in node.elts)
-        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
             return all(self._host_value(g.iter) for g in node.generators)
         if isinstance(node, ast.IfExp):
             return self._host_value(node.body) and self._host_value(

@@ -106,6 +106,7 @@ from progen_tpu.decode.paging import (
     prefix_key,
 )
 from progen_tpu.decode.handoff import Handle, HandoffQueue
+from progen_tpu.decode.qos import QoSQueue
 from progen_tpu.decode.prefill import (
     _constrain_caches,
     harvest_caches,
@@ -167,6 +168,12 @@ class Request:
     positions past ``G`` are unconstrained); ``tenant`` selects a row of
     the engine's LoRA adapter bank (0 = base model; nonzero requires the
     engine to hold a bank).
+
+    QoS knobs (docs/SERVING.md §10): ``priority`` picks the scheduling
+    class (higher = more urgent; classes are served strictly in order
+    and a high-priority arrival may PREEMPT a lower-priority in-flight
+    request — the replay is bit-exact); within a class, tenants share
+    by weight (``qos_weights``) and deadlines order EDF.
     """
 
     uid: Any
@@ -185,6 +192,7 @@ class Request:
     # frontend sets "embed" via submit_embed(); in-process callers use
     # the engine's submit()/submit_embed() methods directly
     workload: str = "generate"
+    priority: int = 0
 
 
 @dataclasses.dataclass
@@ -255,6 +263,17 @@ class ServingEngine:
     first-time compiles.  Counters live in ``self.robust``
     (:func:`robustness_counters` merges everything).
 
+    QoS knobs (docs/SERVING.md §10): admission runs through a
+    priority / weighted-fair / EDF scheduling queue
+    (``decode/qos.py``) — ``qos_weights`` maps tenant -> relative share
+    (missing tenants weigh 1.0; nonzero-weight tenants are
+    starvation-free).  A high-priority arrival blocked on slots or
+    pages PREEMPTS the lowest-priority in-flight request
+    (:meth:`_maybe_preempt`): the victim replays from scratch
+    bit-exactly, so preemption trades latency, never tokens.  Under
+    ``shed_policy="shed-oldest"`` the victim is the lowest class's
+    oldest request, never a strictly higher class than the newcomer.
+
     **Speculative decoding** (``spec=True``): a draft model
     (``draft_config``/``draft_params``; defaults to the IDENTITY draft —
     the target itself, 100% acceptance) proposes ``spec_k`` tokens per
@@ -290,7 +309,7 @@ class ServingEngine:
                  draft_params=None, spec_k: int = 4,
                  disagg: bool = False, prefill_batch: int | None = None,
                  handoff_depth: int = 2, remote_prefill: bool = False,
-                 lora_bank=None):
+                 lora_bank=None, qos_weights: dict | None = None):
         self.config = config
         self.policy = policy or make_policy()
         self.num_slots = num_slots
@@ -298,8 +317,16 @@ class ServingEngine:
         self.max_len = min(max_len or config.seq_len, config.seq_len)
         self.mesh = mesh
         self.strategies = tuple(strategies)
-        self._queue: deque[Request] = deque()
+        # priority / weighted-fair / EDF scheduling queue — with default
+        # weights, a single tenant and no deadlines it is exact FIFO
+        self._queue = QoSQueue(weights=qos_weights)
+        self.qos_weights = dict(qos_weights or {})
+        self._qos_gauge_keys: set = set()
         self._inflight: dict[int, Request] = {}  # slot -> request
+        # admission recency (slot -> monotone seq) across ALL modes: the
+        # preemption and pool-starvation paths evict youngest-first
+        self._admit_seq = 0
+        self._admit_order: dict[int, int] = {}
         self.completions: list[Completion] = []
         self.chunks_run = 0
         if shed_policy not in ("reject", "shed-oldest"):
@@ -420,8 +447,6 @@ class ServingEngine:
                                         np.int32)
             self._paused = np.zeros((num_slots,), bool)
             self._host_stop = np.zeros((num_slots,), np.int64)
-            self._admit_seq = 0
-            self._admit_order: dict[int, int] = {}  # slot -> admission seq
             self.evictions = 0
             self.pause_events = 0
             self.prefix_hits = 0
@@ -1161,7 +1186,18 @@ class ServingEngine:
             return
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             if self.shed_policy == "shed-oldest":
-                self._shed(self._queue.popleft(), SHED_QUEUE_FULL)
+                # priority-aware: drop the LOWEST class (oldest within
+                # it); when the newcomer ranks below everything queued,
+                # the newcomer is the victim — a strictly higher-priority
+                # request is never shed while a lower one sits queued
+                victim = self._queue.shed_victim()
+                if (victim is not None
+                        and victim.priority <= request.priority):
+                    self._queue.remove(victim)
+                    self._shed(victim, SHED_QUEUE_FULL)
+                else:
+                    self._shed(request, SHED_QUEUE_FULL)
+                    return
             else:
                 self._shed(request, SHED_QUEUE_FULL)
                 return
@@ -1302,8 +1338,59 @@ class ServingEngine:
 
     # ----------------------------------------------------------- admission
 
+    def _maybe_preempt(self) -> None:
+        """Priority preemption: while the scheduler's head is blocked
+        (no free slot, or — paged — no pages for its prime) and some
+        in-flight request ranks STRICTLY below it, cancel the victim and
+        re-enqueue it through the scheduler.  Victim choice: lowest
+        priority class first, then most recently admitted (least decode
+        work thrown away).  Replay-from-scratch is bit-exact — a
+        trajectory depends only on (params, prime, seed, knobs) — so
+        preemption trades only latency, never correctness.  Disabled
+        under disagg: a remote-prefill replica cannot replay locally, so
+        cluster QoS is enforced at each prefill worker's queue instead.
+        """
+        if self.disagg:
+            return
+        while self._queue and self._inflight:
+            head = self._queue[0]
+            blocked = len(self._inflight) >= self.num_slots
+            if not blocked and self.paged:
+                need = pages_for_span(len(head.tokens), self.page_size)
+                blocked = not self._pool.can_allocate(need)
+            if not blocked:
+                return
+            victim = min(
+                self._inflight,
+                key=lambda s: (self._inflight[s].priority,
+                               -self._admit_order.get(s, 0)))
+            if self._inflight[victim].priority >= head.priority:
+                return
+            self._preempt_slot(victim)
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Cancel ``slot``'s in-flight request for a higher class and
+        re-enqueue it THROUGH the scheduler: it keeps its original queue
+        seniority among same-class peers but waits behind the class that
+        displaced it (contrast :meth:`_evict_slot`, whose front-of-queue
+        requeue is the pool-starvation replay path)."""
+        r = self._inflight.pop(slot)
+        if self.paged:
+            self._host_stop[slot] = 0
+            self._free_slot_pages(slot)
+        else:
+            self._admit_order.pop(slot, None)
+        self.state = {**self.state, "active":
+                      self.state["active"].at[slot].set(False)}
+        self._queue.append(r)
+        self.robust.preemptions += 1
+        self._tracer.event("serve.preempt", trace=r.uid, slot=slot)
+
     def _admit_pending(self) -> None:
-        if not self._queue or len(self._inflight) >= self.num_slots:
+        if not self._queue:
+            return
+        self._maybe_preempt()
+        if len(self._inflight) >= self.num_slots:
             return
         try:
             self._guard("serve.admit")
@@ -1364,6 +1451,8 @@ class ServingEngine:
             mask[slot] = True
             tenant[slot] = int(r.tenant)
             self._inflight[slot] = r
+            self._admit_order[slot] = self._admit_seq
+            self._admit_seq += 1
         lmask = self._build_lmask(batch)
         extra = (tenant,) if self.lora else ()
 
@@ -1398,7 +1487,13 @@ class ServingEngine:
         whole prime plus the first sampled token WITHOUT prefix sharing
         (a conservative bound — actual planning below shares whatever it
         can, so the allocation never exceeds the reservation); a blocked
-        head DEFERS everything behind it (no starvation reordering).
+        head DEFERS everything behind it.  "Head" is whatever the QoS
+        scheduler ranks first RIGHT NOW (priority, then weighted-fair
+        tenant share, then EDF) — within one admission round the order
+        is fixed, across rounds a higher-priority arrival may overtake
+        a deferred head (that, plus :meth:`_maybe_preempt`, is the QoS
+        contract; pre-QoS FIFO deferral is the degenerate single-class
+        case).
         """
         free = [i for i in range(self.num_slots) if i not in self._inflight]
         batch: list[tuple[int, Request]] = []
@@ -1967,6 +2062,9 @@ class ServingEngine:
                 self._admit_from_handoff()
                 completed += self._drain_pending()
                 completed += self._harvest_done()
+        # refresh the per-class/per-tenant gauges once per step so
+        # heartbeat-ridden registry snapshots carry current depths
+        self.qos_status()
         return completed
 
     # ----------------------------------------- multi-process handoff API
@@ -2111,6 +2209,8 @@ class ServingEngine:
             entry["logit_mask"] = mask_to_wire(r.logit_mask)
         if int(r.tenant) != 0:
             entry["tenant"] = int(r.tenant)
+        if int(r.priority) != 0:
+            entry["priority"] = int(r.priority)
         deadline = self._deadline_of(r)
         if deadline is not None:
             # perf_counter instants do not survive a process restart;
@@ -2146,7 +2246,8 @@ class ServingEngine:
                 max_new_tokens=e["max_new_tokens"], top_k=e["top_k"],
                 temperature=e["temperature"], seed=e["seed"],
                 on_complete=on_complete, submit_time=now,
-                logit_mask=lmask, tenant=int(e.get("tenant", 0)))
+                logit_mask=lmask, tenant=int(e.get("tenant", 0)),
+                priority=int(e.get("priority", 0)))
             if "deadline_remaining" in e:
                 r.deadline = now + e["deadline_remaining"]
             if e.get("workload") == "embed":
@@ -2352,15 +2453,58 @@ class ServingEngine:
             "spec": self.spec,
             "stage_seconds": {k: round(v, 6) for k, v in
                               list(self.stage_seconds.items())},
+            "qos": self.qos_status(),
             "robust": self.robustness_counters(),
         }
 
+    def qos_status(self) -> dict:
+        """Per-class / per-tenant queue + in-flight occupancy and the
+        scheduler's cumulative tallies — host dicts only, safe from the
+        statusz thread.  Also refreshes the labeled Prometheus gauges so
+        a /metricsz scrape sees current depths."""
+        out = dict(self._queue.stats())
+        inflight_by_class: dict = {}
+        inflight_by_tenant: dict = {}
+        for r in list(self._inflight.values()):
+            inflight_by_class[r.priority] = (
+                inflight_by_class.get(r.priority, 0) + 1)
+            inflight_by_tenant[r.tenant] = (
+                inflight_by_tenant.get(r.tenant, 0) + 1)
+        out["inflight_by_class"] = inflight_by_class
+        out["inflight_by_tenant"] = inflight_by_tenant
+        out["preemptions"] = self.robust.preemptions
+        self._publish_qos_gauges(out)
+        return out
+
+    def _publish_qos_gauges(self, qos: dict) -> None:
+        """Mirror the per-class/per-tenant occupancy into labeled
+        registry gauges (Prometheus exposition + worker heartbeats).
+        Label keys ever seen are re-set every refresh so a drained class
+        reads 0 instead of its last nonzero value."""
+        registry = _metrics.get_registry()
+        fresh: set = set()
+        for name, label, table in (
+                ("engine.queue_depth", "priority", qos["queue_by_class"]),
+                ("engine.queue_depth", "tenant", qos["queue_by_tenant"]),
+                ("engine.inflight", "priority", qos["inflight_by_class"]),
+                ("engine.inflight", "tenant", qos["inflight_by_tenant"])):
+            for key, n in table.items():
+                gname = _metrics.labeled(name, **{label: key})
+                registry.gauge(gname).set(n)
+                fresh.add(gname)
+        for gname in self._qos_gauge_keys - fresh:
+            registry.gauge(gname).set(0)
+        self._qos_gauge_keys |= fresh
+        registry.gauge("engine.preemptions").set(self.robust.preemptions)
+
     def robustness_counters(self) -> dict:
         """Everything a chaos record needs: shed/containment tallies,
-        faults fired by the armed plan, and (paged) pool pressure."""
+        faults fired by the armed plan, QoS scheduling tallies, and
+        (paged) pool pressure."""
         out = dict(self.robust.as_dict())
         injector = faults.get()
         out["faults_fired"] = injector.fired() if injector is not None else 0
+        out["qos"] = self._queue.stats()
         if self.paged:
             out["evictions"] = self.evictions
             out["pause_events"] = self.pause_events

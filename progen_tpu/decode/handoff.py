@@ -297,6 +297,9 @@ def request_to_wire(r, *, now: float | None = None) -> dict:
     tenant = int(getattr(r, "tenant", 0))
     if tenant != 0:
         entry["tenant"] = tenant
+    priority = int(getattr(r, "priority", 0))
+    if priority != 0:
+        entry["priority"] = priority
     deadline = r.deadline
     if deadline is None and r.ttl is not None:
         deadline = r.submit_time + r.ttl
@@ -326,7 +329,8 @@ def request_from_wire(d: dict, *, now: float | None = None,
         max_new_tokens=int(d["max_new_tokens"]),
         top_k=d.get("top_k"), temperature=float(d.get("temperature", 1.0)),
         seed=int(d.get("seed", 0)), on_complete=on_complete,
-        submit_time=now, logit_mask=lmask, tenant=int(d.get("tenant", 0)))
+        submit_time=now, logit_mask=lmask, tenant=int(d.get("tenant", 0)),
+        priority=int(d.get("priority", 0)))
     if "deadline_remaining" in d:
         r.deadline = now + float(d["deadline_remaining"])
     return r

@@ -22,7 +22,9 @@ class RobustnessCounters:
     because a non-transient fault fired on their path.
     ``faults_contained``: transient faults absorbed by an in-place retry
     of the failed phase.  ``fallback_activations``: Pallas paged-kernel
-    failures degraded to the bit-identical XLA path.
+    failures degraded to the bit-identical XLA path.  ``preemptions``:
+    in-flight requests cancelled for a higher priority class and
+    re-queued for bit-exact replay (docs/SERVING.md §10).
     """
 
     sheds_queue_full: int = 0
@@ -30,6 +32,7 @@ class RobustnessCounters:
     failed_faults: int = 0
     faults_contained: int = 0
     fallback_activations: int = 0
+    preemptions: int = 0
 
     def as_dict(self) -> dict[str, int]:
         return dataclasses.asdict(self)

@@ -435,7 +435,8 @@ class ServeCluster:
         if deadline is not None and now > deadline:
             self._shed(uid, SHED_DEADLINE, now)
             return
-        w = self.router.pick_prefill()
+        w = self.router.pick_prefill(
+            priority=getattr(request, "priority", 0))
         if w is None:
             if any(k[0] == "prefill" for k in self._respawning):
                 self._parked_uids.append(uid)

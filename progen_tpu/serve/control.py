@@ -142,6 +142,7 @@ class ControlPlane:
             replica_outstanding=dict(c.router.outstanding),
             queued_uids=len(c._parked_uids),
             stage_seconds=stage_seconds,
+            queued_by_class=c.router.queued_by_class(),
         )
 
     # ----------------------------------------------------------------- ticks
@@ -162,6 +163,7 @@ class ControlPlane:
             "prefill_queue": dict(inputs.prefill_queue),
             "replica_outstanding": dict(inputs.replica_outstanding),
             "queued_uids": inputs.queued_uids,
+            "queued_by_class": dict(inputs.queued_by_class),
         }
         added = []
         for d in self.policy.decide(inputs):

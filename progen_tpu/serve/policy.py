@@ -46,7 +46,10 @@ class PolicyInputs:
     counts requests parked on the driver waiting for any prefill slot.
     ``stage_seconds`` maps stage name -> cumulative seconds (fleet
     totals from worker heartbeats), for policies that weigh relative
-    stage cost."""
+    stage cost.  ``queued_by_class`` maps priority class -> fleet-wide
+    queued-at-prefill count (docs/SERVING.md §10) — journaled with
+    every decision, and available to QoS-aware policies that scale on
+    high-class backlog rather than total depth."""
 
     now: float
     prefill_workers: int
@@ -56,6 +59,7 @@ class PolicyInputs:
     replica_outstanding: dict
     queued_uids: int = 0
     stage_seconds: dict = dataclasses.field(default_factory=dict)
+    queued_by_class: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -45,6 +45,10 @@ class Router:
         self.prefill_gen = {w: 0 for w in range(prefill_workers)}
         self.replica_gen = {r: 0 for r in range(replicas)}
         self.prefill_load = {w: 0 for w in range(prefill_workers)}
+        # worker -> {priority class -> queued count}: the QoS view of
+        # prefill_load, kept in lockstep by the same transitions
+        self.prefill_class_load: dict = {
+            w: {} for w in range(prefill_workers)}
         self.outstanding = {r: 0 for r in range(replicas)}
         self.requests: dict = {}          # uid -> Request
         self.stage: dict = {}             # uid -> ("prefill"|"handle"|"replica", key)
@@ -64,13 +68,24 @@ class Router:
     def _placeable_replicas(self) -> set:
         return self.replica_alive - self.replica_fenced
 
-    def pick_prefill(self) -> int | None:
+    def pick_prefill(self, priority: int = 0) -> int | None:
         """Least queued-requests live, unfenced prefill worker; None
-        when the whole stage is down or fenced (caller sheds/parks)."""
+        when the whole stage is down or fenced (caller sheds/parks).
+        A request lands where the least work of its OWN class or above
+        is queued (ties broken by total load) — lower-class backlog
+        doesn't repel a high-priority request, since each worker's
+        engine schedules it past that backlog anyway.  With uniform
+        priorities both keys equal total load: pre-QoS placement."""
         live = self._placeable_prefill()
         if not live:
             return None
-        return min(sorted(live), key=lambda w: self.prefill_load[w])
+
+        def contending(w: int) -> int:
+            return sum(n for p, n in self.prefill_class_load[w].items()
+                       if p >= priority)
+
+        return min(sorted(live),
+                   key=lambda w: (contending(w), self.prefill_load[w]))
 
     def pick_replica(self, generation: int | None = None) -> int | None:
         """Least-outstanding-tokens live, unfenced replica.  With
@@ -93,8 +108,27 @@ class Router:
         self.stage[uid] = ("prefill", worker)
         self.uid_gen[uid] = self.prefill_gen.get(worker, 0)
         self.prefill_load[worker] += 1
+        cl = self.prefill_class_load.setdefault(worker, {})
+        p = getattr(request, "priority", 0)
+        cl[p] = cl.get(p, 0) + 1
         self.max_prefill_queue = max(self.max_prefill_queue,
                                      self.prefill_load[worker])
+
+    def _dec_prefill(self, worker, uid) -> None:
+        """Undo one ``assign_prefill`` unit of load (stage left prefill:
+        handed off, completed, or requeued)."""
+        if worker in self.prefill_load:
+            self.prefill_load[worker] = max(
+                0, self.prefill_load[worker] - 1)
+        cl = self.prefill_class_load.get(worker)
+        r = self.requests.get(uid)
+        if cl is not None and r is not None:
+            p = getattr(r, "priority", 0)
+            left = cl.get(p, 0) - 1
+            if left > 0:
+                cl[p] = left
+            else:
+                cl.pop(p, None)
 
     def note_handle(self, batch_id: str, uids, src: int) -> None:
         """A prefill worker shipped a handle covering ``uids``.  The
@@ -108,8 +142,7 @@ class Router:
         for uid in uids:
             self._uid_batch[uid] = batch_id
             if self.stage.get(uid, (None,))[0] == "prefill":
-                self.prefill_load[src] = max(
-                    0, self.prefill_load[src] - 1)
+                self._dec_prefill(src, uid)
             self.stage[uid] = ("handle", batch_id)
 
     def forward(self, batch_id: str, replica: int) -> None:
@@ -171,7 +204,7 @@ class Router:
         self.completed.add(uid)
         kind, key = self.stage.pop(uid, (None, None))
         if kind == "prefill" and key in self.prefill_load:
-            self.prefill_load[key] = max(0, self.prefill_load[key] - 1)
+            self._dec_prefill(key, uid)
         elif kind == "replica" and key in self.outstanding:
             r = self.requests[uid]
             self.outstanding[key] = max(
@@ -188,7 +221,7 @@ class Router:
                 continue
             kind, key = self.stage.pop(uid, (None, None))
             if kind == "prefill" and key in self.prefill_load:
-                self.prefill_load[key] = max(0, self.prefill_load[key] - 1)
+                self._dec_prefill(key, uid)
             elif kind == "replica" and key in self.outstanding:
                 r = self.requests[uid]
                 self.outstanding[key] = max(
@@ -208,6 +241,7 @@ class Router:
             self.prefill_fenced.discard(index)
             self.prefill_gen[index] = generation
             self.prefill_load[index] = 0
+            self.prefill_class_load[index] = {}
         else:
             self.replica_alive.add(index)
             self.replica_fenced.discard(index)
@@ -231,6 +265,7 @@ class Router:
             self.prefill_fenced.discard(index)
             self.prefill_gen.pop(index, None)
             self.prefill_load.pop(index, None)
+            self.prefill_class_load.pop(index, None)
         else:
             self.replica_alive.discard(index)
             self.replica_fenced.discard(index)
@@ -288,11 +323,22 @@ class Router:
         if role == "prefill":
             self.prefill_alive.add(index)
             self.prefill_load[index] = 0
+            self.prefill_class_load[index] = {}
         else:
             self.replica_alive.add(index)
             self.outstanding[index] = 0
 
     # ----------------------------------------------------------------- stats
+
+    def queued_by_class(self) -> dict:
+        """Fleet-wide queued-at-prefill count per priority class — the
+        control plane journals this with each decision so overload
+        actions are attributable to the class that caused them."""
+        agg: dict = {}
+        for cl in self.prefill_class_load.values():
+            for p, n in cl.items():
+                agg[p] = agg.get(p, 0) + n
+        return agg
 
     def stats(self) -> dict:
         return {
@@ -303,6 +349,9 @@ class Router:
             "prefill_gen": dict(self.prefill_gen),
             "replica_gen": dict(self.replica_gen),
             "prefill_load": dict(self.prefill_load),
+            "prefill_class_load": {w: dict(cl) for w, cl in
+                                   self.prefill_class_load.items()},
+            "queued_by_class": self.queued_by_class(),
             "outstanding_tokens": dict(self.outstanding),
             "max_prefill_queue": self.max_prefill_queue,
             "max_outstanding_tokens": self.max_outstanding,

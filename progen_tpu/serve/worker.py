@@ -176,6 +176,10 @@ def _stats_frame(eng, counters, **extra) -> dict:
            "stage_seconds": eng.stage_seconds,
            "transport": counters.as_dict(),
            "chunks_run": eng.chunks_run,
+           # every role reports its robustness + QoS tallies: prefill
+           # workers own the cluster's scheduling queues, so their
+           # per-class/per-tenant counters are the fleet QoS view
+           "robust": eng.robustness_counters(),
            "metrics": get_registry().snapshot()}
     msg.update(extra)
     return msg
@@ -291,8 +295,7 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
                 running = False
             elif t == "stats_req":
                 peer.send_json(_stats_frame(
-                    eng, counters, max_handoff_backlog=max_backlog,
-                    robust=eng.robustness_counters()))
+                    eng, counters, max_handoff_backlog=max_backlog))
         while backlog:
             entry = backlog[0]
             if entry[2] is None:
@@ -332,8 +335,7 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
                 "stage_seconds": eng.stage_seconds,
                 "metrics": get_registry().snapshot()})
     peer.send_json(_stats_frame(eng, counters,
-                                max_handoff_backlog=max_backlog,
-                                robust=eng.robustness_counters()))
+                                max_handoff_backlog=max_backlog))
 
 
 def main(argv) -> int:

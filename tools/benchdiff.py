@@ -15,9 +15,14 @@ numeric field that both sides carry.  A delta beyond the metric's noise
 band, in the metric's BAD direction, is a regression:
 
 * higher-is-better: ``tokens_per_sec``, ``goodput_tokens_per_sec``,
-  ``within_slo_frac``, ``accepted_tokens_per_step``
+  ``within_slo_frac``, ``accepted_tokens_per_step``,
+  ``qos_fairness_index``
 * lower-is-better: ``p50_latency_s``, ``p95_latency_s``, ``wall_s``,
-  ``slo_burn_rate``
+  ``slo_burn_rate``, ``hi_p95_latency_v``
+
+The two QoS fields come from the virtual-time trace replay
+(``serving_qos`` records), are bit-deterministic by construction, and
+therefore carry near-zero default bands.
 
 Default noise bands are deliberately wide (CPU-proof benches on shared
 runners are noisy); tighten per-metric with ``--band name=frac``.
@@ -51,6 +56,12 @@ WATCHED: dict[str, tuple[int, float]] = {
     # zero baseline makes ANY dropped request a regression)
     "shed_rate": (-1, 0.50),
     "swap_dropped": (-1, 0.50),
+    # QoS trace replay (bench_serving.py --trace-file): both fields are
+    # computed on VIRTUAL time from a committed trace, so they are
+    # bit-deterministic across machines and the bands can be near-zero —
+    # any drift is a scheduling change, not noise
+    "qos_fairness_index": (+1, 0.02),
+    "hi_p95_latency_v": (-1, 0.02),
 }
 
 

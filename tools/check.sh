@@ -105,6 +105,37 @@ if python tools/benchdiff.py "$BENCH_DIR/base.jsonl" "$BENCH_DIR/bad.jsonl"; the
     exit 1
 fi
 
+echo "== qos-overload smoke =="
+# replay the committed 2x-overload trace (benchmarks/traces/) on virtual
+# time with --verify: priority preemption fires, shed-oldest and
+# deadline sheds are typed completions, every non-shed completion is
+# token-identical to an uncontended rerun, the high class's p95 beats a
+# FIFO rerun of the same trace, and no nonzero-weight tenant starves
+# (docs/SERVING.md §10)
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --slots 2 --chunk 4 --max-new 6 \
+    --trace-file benchmarks/traces/overload_2x.jsonl \
+    --verify --out "$BENCH_DIR/qos.jsonl"
+# virtual-time determinism makes the QoS fields exact: the self-diff
+# must pass, and an injected fairness/priority regression must FAIL —
+# the gate that catches a scheduling regression before it ships
+python tools/benchdiff.py --metric serving_qos \
+    "$BENCH_DIR/qos.jsonl" "$BENCH_DIR/qos.jsonl"
+python - "$BENCH_DIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+rec = json.loads(open(f"{d}/qos.jsonl").readline())
+rec["qos_fairness_index"] = rec["qos_fairness_index"] * 0.5  # starved tenant
+rec["hi_p95_latency_v"] = rec["hi_p95_latency_v"] * 5 + 1.0  # class inversion
+rec["wall_time"] = rec.get("wall_time", 0) + 1
+open(f"{d}/qos_bad.jsonl", "w").write(json.dumps(rec) + "\n")
+EOF
+if python tools/benchdiff.py --metric serving_qos \
+        "$BENCH_DIR/qos.jsonl" "$BENCH_DIR/qos_bad.jsonl"; then
+    echo "benchdiff FAILED to flag an injected QoS regression" >&2
+    exit 1
+fi
+
 echo "== elastic-serving smoke =="
 # elastic control plane on a real cluster: a bursty schedule forces a
 # scale-up (warm-before-routable), plus a rolling LoRA hot-swap mid-run;
