@@ -196,6 +196,17 @@ def main() -> None:
                          "hot-swap weights via a rolling worker upgrade "
                          "(new generation, zero dropped requests); the "
                          "record gains the swap outcome")
+    ap.add_argument("--zipf", type=float, default=None, metavar="ALPHA",
+                    help="popular-prompt mix: draw every prime from a "
+                         "pool of --zipf-pool distinct prompts with "
+                         "Zipf(ALPHA) weights instead of fresh random "
+                         "primes — the repeated-prefix workload the "
+                         "prefix cache dedups; with --serve-procs "
+                         "--paged this records a serving_fleetcache "
+                         "line comparing cache-aware vs cache-blind "
+                         "routing on the same schedule")
+    ap.add_argument("--zipf-pool", type=int, default=8,
+                    help="distinct prompts in the --zipf pool")
     ap.add_argument("--long-frac", type=float, default=0.0,
                     help="fraction of requests with near-max_len primes "
                          "(mixed long-prefill load); the rest draw short "
@@ -326,7 +337,21 @@ def main() -> None:
     # request specs are FIXED up front so a --verify fault-free rerun
     # replays the exact same (tokens, seed) set — per-request seed
     # determinism then makes token identity a hard assert, not a hope
-    if args.long_frac > 0:
+    if args.zipf is not None:
+        # Zipf popular-prompt mix: K distinct primes, request i draws
+        # prime rank r with p(r) ~ 1/r^alpha — repeated primes are what
+        # the (fleet) prefix cache dedups.  Pool and assignment come
+        # from the SAME fixed rng stream as the plain specs, so --verify
+        # reruns replay the identical mix.
+        pool_n = max(1, args.zipf_pool)
+        pool = [rng.integers(1, cfg.num_tokens,
+                             int(rng.integers(pmin, pmax + 1))).tolist()
+                for _ in range(pool_n)]
+        pmf = 1.0 / np.arange(1, pool_n + 1) ** float(args.zipf)
+        pmf /= pmf.sum()
+        specs = [list(pool[int(i)])
+                 for i in rng.choice(pool_n, size=args.requests, p=pmf)]
+    elif args.long_frac > 0:
         short_hi = max(pmin, pmax // 4)
         specs = [rng.integers(
             1, cfg.num_tokens,
@@ -500,8 +525,12 @@ def main() -> None:
         return served, time.perf_counter() - t0, mif
 
     if args.serve_procs:
-        _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
-                       drive, make_request, arrivals, pmax)
+        if args.zipf is not None and args.paged:
+            _run_fleetcache(args, cfg, params, max_len, paged_kwargs,
+                            mk_engine, make_request, arrivals, pmax)
+        else:
+            _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine,
+                           warm, drive, make_request, arrivals, pmax)
         return
 
     engine = mk_engine(robust=True)
@@ -1221,6 +1250,174 @@ def _run_multiproc(args, cfg, max_len, paged_kwargs, mk_engine, warm,
         merged = merge_trace_dir(args.trace_out)
         if merged:
             record["trace"] = merged
+
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(line + "\n")
+
+
+def _run_fleetcache(args, cfg, params, max_len, paged_kwargs,
+                    mk_engine, make_request, arrivals, pmax) -> None:
+    """--zipf + --serve-procs + --paged: measure the SAME Zipf popular-
+    prompt schedule on two fresh clusters — cache-aware routing (each
+    request goes to the replica whose advertised prefix digest covers
+    the longest prime prefix) vs cache-blind (load-only) — and emit one
+    ``serving_fleetcache`` record carrying the side-by-side
+    (docs/SERVING.md §11).
+
+    TTFT is driver-observed: handle arrival minus submit, both on the
+    driver clock, so the two runs are compared on one clock with no
+    cross-process correction.  ``prefill_flops_saved`` is MODELED from
+    page-level hits (``hits x page_size rows x 2 x n_params``): a
+    prefix hit dedups pool pages (pressure relief — fewer deferrals,
+    evictions and admission pauses under a tight ``--num-pages``), it
+    does not skip the batched prefill math.
+    """
+    if args.chaos:
+        raise SystemExit("--chaos drives the in-process fault injector; "
+                         "drop it for the --zipf fleetcache comparison")
+    from progen_tpu.decode import Request
+    from progen_tpu.serve.cluster import ServeCluster
+    from progen_tpu.serve.worker import make_spec
+
+    engine_kw = dict(num_slots=args.slots, chunk_size=args.chunk,
+                     max_len=max_len,
+                     prefill_batch=args.prefill_batch,
+                     handoff_depth=args.handoff_depth, **paged_kwargs)
+    wspec = make_spec(cfg, mixed_precision=True, init_seed=0,
+                      engine=engine_kw, statusz=args.statusz)
+
+    def drive_cluster(route_by_cache: bool):
+        cluster = ServeCluster(wspec, prefill_procs=args.prefill_procs,
+                               replicas=args.replicas,
+                               route_by_cache=route_by_cache)
+        try:
+            # warm off the clock: sacrificial requests compile prefill +
+            # merge + chunk programs in every worker (distinct primes —
+            # their cached pages are cold and evict first under load)
+            wrng = np.random.default_rng(args.seed + 999)
+            for i in range(max(2, args.prefill_procs, args.replicas)):
+                cluster.submit(Request(
+                    uid=10_000_000 + i,
+                    tokens=wrng.integers(1, cfg.num_tokens, pmax).tolist(),
+                    max_new_tokens=args.max_new, top_k=25,
+                    temperature=1.0, seed=args.seed,
+                    submit_time=time.perf_counter()))
+            cluster.drain(timeout=600.0)
+            cluster.poll(0.0)  # discard the warm completions
+
+            t0 = time.perf_counter()
+            served: list = []
+            nxt = 0
+            while len(served) < args.requests:
+                now = time.perf_counter() - t0
+                while nxt < args.requests and arrivals[nxt] <= now:
+                    cluster.submit(make_request(nxt, t0 + arrivals[nxt],
+                                                ttl=args.ttl))
+                    nxt += 1
+                served.extend(cluster.poll(0.02))
+            wall = time.perf_counter() - t0
+        finally:
+            stats = cluster.shutdown()
+        return served, wall, stats
+
+    def summarize(done, wall, stats):
+        ok = [c for c in done if c.ok]
+        lat = sorted(c.latency for c in ok) or [0.0]
+        p50, p95 = latency_percentiles(lat, name="bench.cluster_latency_s")
+        ttfts = sorted(c.ttft for c in ok if c.ttft is not None) or [0.0]
+        t50, t95 = latency_percentiles(ttfts, name="bench.cluster_ttft_s")
+        gen = int(sum(len(c.tokens) for c in ok))
+        hits = lookups = 0
+        for w, st in stats["workers"].items():
+            if not w.startswith("decode:"):
+                continue
+            rb = st.get("robust") or {}
+            if os.environ.get("FLEETCACHE_DEBUG"):
+                print(f"debug {w}: hits={rb.get('prefix_hits')} "
+                      f"lookups={rb.get('prefix_lookups')} "
+                      f"evictions={rb.get('evictions')}", file=sys.stderr)
+            hits += int(rb.get("prefix_hits", 0))
+            lookups += int(rb.get("prefix_lookups", 0))
+        rt = stats.get("router", {})
+        return {
+            "ok_requests": len(ok),
+            "generated_tokens": gen,
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(gen / wall, 1) if wall else 0.0,
+            "p50_latency_s": round(p50, 3),
+            "p95_latency_s": round(p95, 3),
+            "ttft_p50": round(t50, 4),
+            "ttft_p95": round(t95, 4),
+            "fleet_prefix_hits": hits,
+            "fleet_prefix_lookups": lookups,
+            "fleet_prefix_hit_rate": (round(hits / lookups, 4)
+                                      if lookups else 0.0),
+            "cache_routed": int(rt.get("cache_routed", 0)),
+            "cache_fallback": int(rt.get("cache_fallback", 0)),
+        }, ok
+
+    with profile_trace(args.xprof_dir):
+        aware_sum, aware_ok = summarize(*drive_cluster(True))
+    blind_sum, blind_ok = summarize(*drive_cluster(False))
+
+    n_params = int(sum(x.size for x in jax.tree_util.tree_leaves(params)))
+    page_size = int(paged_kwargs.get("page_size") or 16)
+    rows = aware_sum["fleet_prefix_hits"] * page_size
+    record = stamp_record({
+        "metric": "serving_fleetcache",
+        "config": args.config,
+        "requests": args.requests,
+        "rate_per_sec": args.rate,
+        "zipf_alpha": args.zipf,
+        "zipf_pool": args.zipf_pool,
+        "slots": args.slots,
+        "chunk": args.chunk,
+        "max_new_tokens": args.max_new,
+        "max_len": max_len,
+        "page_size": page_size,
+        "num_pages": paged_kwargs.get("num_pages"),
+        "prefill_procs": args.prefill_procs,
+        "replicas": args.replicas,
+        **aware_sum,
+        # modeled dedup value: gate rows NOT freshly written because a
+        # cached page covered them (2 flops/row/param convention)
+        "prefill_rows_deduped": rows,
+        "prefill_flops_saved": rows * 2 * n_params,
+        "cache_blind": blind_sum,
+        "ttft_p95_blind": blind_sum["ttft_p95"],
+        "ttft_p95_speedup": (round(
+            blind_sum["ttft_p95"] / aware_sum["ttft_p95"], 3)
+            if aware_sum["ttft_p95"] > 0 else 0.0),
+        "platform": jax.devices()[0].platform,
+    })
+
+    if args.verify:
+        # placement is a performance hint, never a correctness input:
+        # both clusters must be token-identical to the plain
+        # single-process engine on the same (tokens, seed) set
+        plain = mk_engine(robust=False, use_spec=False, use_disagg=False)
+        for uid in range(args.requests):
+            plain.submit(make_request(uid, time.perf_counter()))
+        clean = {c.uid: [int(t) for t in c.tokens]
+                 for c in plain.run_until_idle()}
+        for tag, comps in (("cache-aware", aware_ok),
+                           ("cache-blind", blind_ok)):
+            mism = [c.uid for c in comps
+                    if [int(t) for t in c.tokens] != clean[c.uid]]
+            assert not mism, (
+                f"{tag} cluster diverged from the single-process engine "
+                f"for uids {mism} — placement changed tokens")
+        aw = {c.uid: [int(t) for t in c.tokens] for c in aware_ok}
+        bl = {c.uid: [int(t) for t in c.tokens] for c in blind_ok}
+        assert aw == bl, (
+            "cache-aware and cache-blind completions differ — routing "
+            "policy leaked into the token stream")
+        record["verified"] = True
+        print("verify: fleetcache token identity (cache-aware == "
+              "cache-blind == single-process) OK", file=sys.stderr)
 
     line = json.dumps(record)
     print(line, flush=True)

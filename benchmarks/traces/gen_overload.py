@@ -1,6 +1,8 @@
 #!/usr/bin/env python
 """Regenerate ``benchmarks/traces/overload_2x.jsonl`` — the committed
-2x-overload QoS trace ``tools/check.sh`` replays with ``--verify``.
+2x-overload QoS trace ``tools/check.sh`` replays with ``--verify`` —
+and, with ``--zipf``, ``benchmarks/traces/fleetcache_zipf.jsonl``, the
+Zipf popular-prompt trace behind the fleet prefix-cache comparison.
 
 The trace is data, not code: a header line fixing the virtual clock
 (``step_dt``), the tenant weight map and the admission bound, then one
@@ -28,11 +30,18 @@ Shape choices, all deliberate:
 * every 5th request reuses prime_seed 1000 at length 8 — a Zipf-style
   hot prompt that exercises the prefix cache under ``--paged``.
 
+The ``--zipf`` trace instead draws EVERY arrival's prime from a pool of
+``--zipf-pool`` distinct prompts with p(rank r) ~ 1/r^alpha — the
+repeated-prefix workload docs/SERVING.md §11's fleet cache dedups.  The
+pool assignment is a fixed arithmetic function of the uid (no RNG), so
+the file is reproducible without pinning a generator version.
+
 Primes are regenerated from ``(prime_seed, prime_len)`` at replay, so
-the file is vocabulary-agnostic.  Rerunning this script reproduces the
-committed file byte-for-byte.
+the files are vocabulary-agnostic.  Rerunning this script reproduces
+the committed files byte-for-byte.
 """
 
+import argparse
 import json
 import os
 
@@ -71,14 +80,84 @@ def entry(uid: int) -> dict:
     return e
 
 
+# --------------------------------------------------------------- zipf trace
+
+ZIPF_N = 24
+ZIPF_HEADER = {
+    "kind": "qos_trace",
+    "version": 1,
+    "name": "fleetcache_zipf",
+    "step_dt": 1.0,
+    "max_new": 8,
+    "weights": {},
+}
+
+# prime length per pool rank (hot prompts long enough to span several
+# pages at page_size 4-8, the tail shorter)
+ZIPF_LENS = [16, 16, 12, 12, 8, 8, 8, 8]
+
+
+def _zipf_rank(uid: int, pool: int, alpha: float) -> int:
+    """Deterministic Zipf-ish rank for ``uid``: walk the cumulative
+    1/r^alpha mass with a fixed low-discrepancy point per uid (golden-
+    ratio stride), so rank frequencies match the pmf without an RNG."""
+    pmf = [1.0 / (r + 1) ** alpha for r in range(pool)]
+    total = sum(pmf)
+    u = (uid * 0.6180339887498949 + 0.314159) % 1.0
+    acc = 0.0
+    for r, p in enumerate(pmf):
+        acc += p / total
+        if u < acc:
+            return r
+    return pool - 1
+
+
+def zipf_entry(uid: int, pool: int, alpha: float) -> dict:
+    r = _zipf_rank(uid, pool, alpha)
+    return {
+        "uid": uid,
+        "at": round(0.4 * uid, 2),
+        "prime_seed": 5000 + r,  # pool rank IS the prompt identity
+        "prime_len": ZIPF_LENS[r % len(ZIPF_LENS)],
+        "priority": 0,
+        "tenant": 0,
+        "max_new": 8,
+        "seed": 100 + uid,
+    }
+
+
 def main() -> None:
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "overload_2x.jsonl")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--zipf", type=float, default=None, metavar="ALPHA",
+                    help="also write fleetcache_zipf.jsonl with this "
+                         "Zipf exponent (the committed file uses 1.1)")
+    ap.add_argument("--zipf-pool", type=int, default=8)
+    args = ap.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(here, "overload_2x.jsonl")
     with open(out, "w") as f:
         f.write(json.dumps(HEADER) + "\n")
         for uid in range(N):
             f.write(json.dumps(entry(uid)) + "\n")
     print(f"wrote {out}: {N} arrivals")
+
+    if args.zipf is not None:
+        zout = os.path.join(here, "fleetcache_zipf.jsonl")
+        header = dict(ZIPF_HEADER)
+        header["zipf_alpha"] = args.zipf
+        header["zipf_pool"] = args.zipf_pool
+        with open(zout, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for uid in range(ZIPF_N):
+                f.write(json.dumps(
+                    zipf_entry(uid, args.zipf_pool, args.zipf)) + "\n")
+        ranks = [_zipf_rank(u, args.zipf_pool, args.zipf)
+                 for u in range(ZIPF_N)]
+        hot = ranks.count(0)
+        print(f"wrote {zout}: {ZIPF_N} arrivals, "
+              f"{len(set(ranks))} distinct prompts, "
+              f"{hot} hits on the hottest")
 
 
 if __name__ == "__main__":

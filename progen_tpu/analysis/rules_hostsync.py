@@ -64,7 +64,8 @@ HOT_ZONES: tuple[Zone, ...] = (
         r"|admit_handle|run_prefill_round|drain_sheds|_note_stage"
         r"|submit_embed|_embed_round|run_embed_round|embed_pending"
         r"|_build_lmask|status|_maybe_preempt|_preempt_slot|qos_status"
-        r"|_publish_qos_gauges)$",
+        r"|_publish_qos_gauges|submit_fork|_release_forks|forget_ttft"
+        r"|prefix_digest|cache_status|_publish_cache_gauges)$",
         frozenset({"_inflight", "_queue", "completions", "config",
                    "num_slots", "max_len", "chunks_run", "_pool",
                    "_slot_pages", "_page_table", "_paused", "_host_stop",
@@ -77,7 +78,8 @@ HOT_ZONES: tuple[Zone, ...] = (
                    "spec", "spec_k", "prefill_batch", "_max_advance",
                    "_spec_rounds", "remote_prefill", "stage_seconds",
                    "_tracer", "_stage_hist", "_embed_queue", "lora",
-                   "qos_weights", "_qos_gauge_keys"}),
+                   "qos_weights", "_qos_gauge_keys", "prefix_lookups",
+                   "fork_groups", "_fork_wait", "_ttft"}),
         # requests, admission rows and snapshots are host payloads by API
         # contract: numpy masks, python ints, JSON-safe dicts — never
         # device arrays
@@ -114,7 +116,15 @@ HOT_ZONES: tuple[Zone, ...] = (
                     "_uid_batch", "completed", "submit_times",
                     "max_prefill_queue", "max_outstanding",
                     "prefill_fenced", "replica_fenced",
-                    "prefill_gen", "replica_gen", "uid_gen"})),
+                    "prefill_gen", "replica_gen", "uid_gen",
+                    "replica_digest", "_optimistic", "_page_size_hint",
+                    "route_by_cache", "digest_ttl",
+                    "cache_imbalance_tokens", "cache_routed",
+                    "cache_fallback", "cache_overridden"}),
+         # advertised digests are parsed-JSON wire payloads and the
+         # routing knobs are host scalars by constructor contract
+         frozenset({"digest", "route_by_cache", "digest_ttl",
+                    "cache_imbalance_tokens", "now"})),
     # the cluster's ADMISSION/event side must not sync (wire headers are
     # parsed JSON; numpy-building lives in module helpers outside the
     # zone); spawn/accept/log plumbing is transport-side and unzoned
@@ -122,6 +132,7 @@ HOT_ZONES: tuple[Zone, ...] = (
          r"ServeCluster\.(submit|_dispatch|_shed|poll|pending|drain"
          r"|_pump|_handle_event|_on_hello|_on_handle|_on_peer_dead"
          r"|_return_credit|_check_stale|_note_clock|fleet_metrics"
+         r"|_note_cache_frame|cache_stats"
          r"|_statusz_health|_statusz_status)$",
          frozenset({"router", "completions", "supervisor", "counters",
                     "_new", "_events", "_peers", "_procs",
@@ -133,7 +144,8 @@ HOT_ZONES: tuple[Zone, ...] = (
                     "_slo", "_slo_last", "_ok_ctr", "_shed_ctr",
                     "generation", "_worker_gen", "_worker_spec",
                     "_retiring", "_pending_routable", "_next_idx",
-                    "_spec_paths", "_statusz_providers"})),
+                    "_spec_paths", "_statusz_providers",
+                    "_ttft", "_cache_counts"})),
     # the control plane's tick sits between poll rounds on the drive
     # loop: pure host policy over router/heartbeat bookkeeping, any
     # sync here would stall every request in flight

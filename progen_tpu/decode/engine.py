@@ -218,10 +218,22 @@ class Completion:
     # weight generation that primed the request — the serving control
     # plane bumps this on swap_weights; 0 for a never-swapped engine
     generation: int = 0
+    # instant the request's FIRST generated token existed (admission
+    # dispatch returned) — None for sheds and embed completions.  The
+    # cluster rewrites this onto the driver clock so ``ttft`` is
+    # end-to-end (queue + prefill + transport + merge) fleet-wide.
+    first_token_time: float | None = None
 
     @property
     def latency(self) -> float:
         return self.finish_time - self.submit_time
+
+    @property
+    def ttft(self) -> float | None:
+        """Time to first token, or None when it was never produced."""
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
 
     @property
     def ok(self) -> bool:
@@ -323,6 +335,12 @@ class ServingEngine:
         self.qos_weights = dict(qos_weights or {})
         self._qos_gauge_keys: set = set()
         self._inflight: dict[int, Request] = {}  # slot -> request
+        # shared-prefix forking (submit_fork): leader uid -> followers
+        # held back until the leader's prefix pages are published, plus
+        # first-token instants for the TTFT field on completions
+        self._fork_wait: dict[Any, list[Request]] = {}
+        self.fork_groups = 0
+        self._ttft: dict[Any, float] = {}
         # admission recency (slot -> monotone seq) across ALL modes: the
         # preemption and pool-starvation paths evict youngest-first
         self._admit_seq = 0
@@ -450,6 +468,7 @@ class ServingEngine:
             self.evictions = 0
             self.pause_events = 0
             self.prefix_hits = 0
+            self.prefix_lookups = 0
             self._paged_step_model = ProGenPagedDecodeStep(
                 config=config, n_rows=self.max_len, policy=self.policy,
                 impl=paged_impl)
@@ -1245,9 +1264,77 @@ class ServingEngine:
         self._tracer.event("serve.submit_embed", trace=request.uid,
                            queue=len(self._embed_queue))
 
+    def submit_fork(self, request: Request, n_samples: int) -> list:
+        """Best-of-N: fork ``n_samples`` trajectories off one shared
+        prime.  Fork ``k`` is ``request`` with ``uid + k`` and ``seed +
+        k`` — each completion is token-identical to submitting that
+        request independently (a trajectory depends only on (params,
+        prime, seed, knobs)), so callers may rank or dedup the samples
+        freely.  The caller owns uid-space: ``uid .. uid+n-1`` must be
+        unused.
+
+        On a paged engine with the prefix cache enabled the forks share
+        the prime's full prefix pages through the pool's refcounts — the
+        leader (fork 0) is submitted immediately and primes the pages;
+        the followers are held until the leader's registrations publish
+        (or the leader sheds), then admitted as cache hits, so N samples
+        cost one set of prime pages instead of N.  Dense engines and
+        ``prefix_cache=False`` pools submit all forks immediately (same
+        tokens, no sharing to exploit).  Returns the fork uids in order;
+        sheds still answer per-fork as typed completions."""
+        if n_samples < 1:
+            raise ValueError(f"request {request.uid!r}: n_samples must "
+                             f"be >= 1, got {n_samples}")
+        if not isinstance(request.uid, int):
+            raise ValueError(f"request {request.uid!r}: submit_fork "
+                             f"derives fork uids by offset — uid must "
+                             f"be an int")
+        forks = [dataclasses.replace(request, uid=request.uid + k,
+                                     seed=request.seed + k)
+                 for k in range(n_samples)]
+        self.fork_groups += 1
+        share = (self.paged and self._pool.prefix_caching
+                 and n_samples > 1
+                 and len(request.tokens) >= self.page_size)
+        self.submit(forks[0])
+        if not share:
+            for f in forks[1:]:
+                self.submit(f)
+        else:
+            # hold the followers until the leader's prefix pages are
+            # published — released by _release_forks on the step after
+            # the leader leaves the queue (admitted OR shed), so a shed
+            # leader never strands its followers
+            self._fork_wait[forks[0].uid] = forks[1:]
+        self._tracer.event("serve.submit_fork", trace=request.uid,
+                           n_samples=n_samples)
+        return [f.uid for f in forks]
+
+    def forget_ttft(self, uids) -> None:
+        """Drop first-token stamps for requests that leave this engine
+        for another process (prefill workers hand off and never harvest
+        locally), so the stamp map cannot grow without bound."""
+        for u in uids:
+            self._ttft.pop(u, None)
+
+    def _release_forks(self) -> None:
+        """Submit fork followers whose leader has left the queue (its
+        admission committed the shared prefix registrations — or it shed,
+        in which case the followers proceed unshared).  Runs at the top
+        of :meth:`step` so followers land one admission round behind
+        their leader."""
+        if not self._fork_wait:
+            return
+        queued = {r.uid for r in self._queue}
+        ready = [uid for uid in self._fork_wait if uid not in queued]
+        for uid in ready:
+            for f in self._fork_wait.pop(uid):
+                self.submit(f)
+
     @property
     def pending(self) -> int:
-        return len(self._queue) + len(self._embed_queue)
+        return (len(self._queue) + len(self._embed_queue)
+                + sum(len(v) for v in self._fork_wait.values()))
 
     @property
     def num_active(self) -> int:
@@ -1256,10 +1343,11 @@ class ServingEngine:
     @property
     def has_work(self) -> bool:
         """True while anything remains for ``step()`` to do or report —
-        queued requests, in-flight slots, or shed completions not yet
-        returned by a ``step()`` call."""
+        queued requests, held fork followers, in-flight slots, or shed
+        completions not yet returned by a ``step()`` call."""
         n = (len(self._queue) + len(self._embed_queue)
-             + len(self._inflight) + len(self._pending))
+             + len(self._inflight) + len(self._pending)
+             + sum(len(v) for v in self._fork_wait.values()))
         if self.disagg:
             n += len(self._handoff)
         return n > 0
@@ -1292,7 +1380,8 @@ class ServingEngine:
                 [] if tokens is None else tokens, np.int32),
             finish_reason=status, status=status,
             submit_time=r.submit_time, finish_time=time.perf_counter(),
-            generation=self.generation)
+            generation=self.generation,
+            first_token_time=self._ttft.pop(r.uid, None))
         self.completions.append(comp)
         self._pending.append(comp)
         self._tracer.event("serve.shed", trace=r.uid, status=status)
@@ -1479,6 +1568,13 @@ class ServingEngine:
                 self._inflight.pop(slot, None)
                 self._queue.appendleft(r)
             raise
+        else:
+            # the admit program samples each request's first token, so
+            # admission success IS first-token time; setdefault keeps the
+            # earliest stamp across evict/replay round-trips
+            now = time.perf_counter()
+            for _, r in batch:
+                self._ttft.setdefault(r.uid, now)
 
     def _admit_pending_paged(self) -> None:
         """FIFO admission gated by free slots AND free pages.
@@ -1522,22 +1618,55 @@ class ServingEngine:
         tenant = np.zeros((s,), np.int32)
         wtable = np.full((s, self.pages_per_row), DUMP_PAGE, np.int32)
         pending_prefix: list[tuple[tuple, int]] = []
-        for slot, r in batch:
-            t = np.asarray(r.tokens, np.int32)
-            tokens[slot, : len(t)] = t
-            lengths[slot] = len(t)
-            stops[slot] = min(len(t) + r.max_new_tokens, self.max_len)
-            seeds[slot] = np.uint32(int(r.seed) & 0xFFFFFFFF)
-            top_k[slot] = 0 if r.top_k is None else int(r.top_k)
-            temp[slot] = float(r.temperature)
-            mask[slot] = True
-            tenant[slot] = int(r.tenant)
-            self._inflight[slot] = r
-            self._host_stop[slot] = stops[slot]
-            self._admit_order[slot] = self._admit_seq
-            self._admit_seq += 1
-            self._paused[slot] = False
-            self._plan_slot_pages(slot, r, p_pad, wtable, pending_prefix)
+        planned: list[tuple[int, Request]] = []
+        try:
+            for slot, r in batch:
+                t = np.asarray(r.tokens, np.int32)
+                tokens[slot, : len(t)] = t
+                lengths[slot] = len(t)
+                stops[slot] = min(len(t) + r.max_new_tokens, self.max_len)
+                seeds[slot] = np.uint32(int(r.seed) & 0xFFFFFFFF)
+                top_k[slot] = 0 if r.top_k is None else int(r.top_k)
+                temp[slot] = float(r.temperature)
+                mask[slot] = True
+                tenant[slot] = int(r.tenant)
+                self._inflight[slot] = r
+                self._host_stop[slot] = stops[slot]
+                self._admit_order[slot] = self._admit_seq
+                self._admit_seq += 1
+                self._paused[slot] = False
+                # planning allocates (and retains shared) pages — a
+                # faultable operation, guarded at the SAME point as the
+                # chunk-growth allocator.  A contained fault mid-batch
+                # rolls back every page planned so far AND the deferred
+                # registrations (pending_prefix dies with this frame) —
+                # the fork path leans on exactly this discipline
+                self._guard("serve.page_alloc", self._plan_slot_pages,
+                            slot, r, p_pad, wtable, pending_prefix)
+                planned.append((slot, r))
+        except _ContainedFault:
+            j = len(planned)
+            for slot, r in reversed(batch[: j + 1]):
+                self._inflight.pop(slot, None)
+                self._host_stop[slot] = 0
+                self._free_slot_pages(slot)
+            # innocents (planned before the fault or never reached) go
+            # back to the queue front in order; only the request whose
+            # planning faulted is shed
+            innocents = [r for _, r in batch[:j] + batch[j + 1:]]
+            for r in reversed(innocents):
+                self._queue.appendleft(r)
+            self._shed(batch[j][1], FAILED_FAULT)
+            return
+        except RetryError:
+            j = len(planned)
+            for slot, r in reversed(batch[: j + 1]):
+                self._inflight.pop(slot, None)
+                self._host_stop[slot] = 0
+                self._free_slot_pages(slot)
+            for _, r in reversed(batch):
+                self._queue.appendleft(r)
+            raise
         lmask = self._build_lmask(batch)
         extra = (tenant,) if self.lora else ()
 
@@ -1572,6 +1701,9 @@ class ServingEngine:
         # published for sharing
         for key, pid in pending_prefix:
             self._pool.register_prefix(key, pid)
+        now = time.perf_counter()
+        for _, r in batch:
+            self._ttft.setdefault(r.uid, now)
 
     # ---------------------------------------------------------- embeddings
 
@@ -1698,6 +1830,12 @@ class ServingEngine:
             for r in reversed(batch):
                 self._queue.appendleft(r)
             raise
+        # the prefill worker samples each request's first token, so the
+        # handle landing IS first-token time (the decode-side merge only
+        # moves already-sampled state into slots)
+        now = time.perf_counter()
+        for r in batch:
+            self._ttft.setdefault(r.uid, now)
         self._handoff.put(Handle(requests=batch, state=h, p_pad=p_pad))
 
     def _admit_from_handoff(self) -> None:
@@ -1792,6 +1930,13 @@ class ServingEngine:
                 else:
                     for key, pid in pending_prefix:
                         self._pool.register_prefix(key, pid)
+                    # remote-prefill handles never passed through this
+                    # engine's _prefill_round; their first token lands
+                    # here (setdefault keeps the local prefill stamp on
+                    # the inline disagg path)
+                    merged = time.perf_counter()
+                    for _, r in live_rows:
+                        self._ttft.setdefault(r.uid, merged)
             for r in expired:
                 self._shed(r, SHED_DEADLINE)
 
@@ -1826,6 +1971,7 @@ class ServingEngine:
         for pid in shared:
             self._pool.retain(pid)
         self.prefix_hits += len(shared)
+        self.prefix_lookups += n_full
         pages = shared + fresh
         for j in range(len(shared), n_full):
             pending_prefix.append(
@@ -1953,7 +2099,8 @@ class ServingEngine:
                 uid=r.uid, prime=np.asarray(r.tokens, np.int32),
                 tokens=toks, finish_reason=reason,
                 submit_time=r.submit_time, finish_time=now,
-                generation=self.generation)
+                generation=self.generation,
+                first_token_time=self._ttft.pop(r.uid, None))
             out.append(comp)
             if r.on_complete is not None:
                 r.on_complete(comp)
@@ -2032,6 +2179,7 @@ class ServingEngine:
         if self._watchdog is not None:
             self._watchdog.beat("serve.step")
         self._shed_expired()
+        self._release_forks()
         if not self._draining:
             if self.disagg:
                 self._admit_from_handoff()
@@ -2065,6 +2213,8 @@ class ServingEngine:
         # refresh the per-class/per-tenant gauges once per step so
         # heartbeat-ridden registry snapshots carry current depths
         self.qos_status()
+        if self.paged:
+            self._publish_cache_gauges()
         return completed
 
     # ----------------------------------------- multi-process handoff API
@@ -2181,6 +2331,10 @@ class ServingEngine:
                     entries.append(self._snap_request(r, []))
         for r in self._queue:
             entries.append(self._snap_request(r, []))
+        for followers in self._fork_wait.values():
+            # held fork followers are queue-like: replay from scratch
+            for r in followers:
+                entries.append(self._snap_request(r, []))
         for r in self._embed_queue:
             e = self._snap_request(r, [])
             e["workload"] = "embed"
@@ -2231,7 +2385,7 @@ class ServingEngine:
         if snap.get("kind") != "serving_snapshot":
             raise ValueError("not a serving snapshot")
         if self._inflight or self._queue or self._embed_queue or \
-                (self.disagg and self._handoff):
+                self._fork_wait or (self.disagg and self._handoff):
             raise RuntimeError("restore() requires an idle engine")
         now = time.perf_counter()
         accepted = 0
@@ -2454,8 +2608,47 @@ class ServingEngine:
             "stage_seconds": {k: round(v, 6) for k, v in
                               list(self.stage_seconds.items())},
             "qos": self.qos_status(),
+            "cache": self.cache_status(),
             "robust": self.robustness_counters(),
         }
+
+    def cache_status(self) -> dict | None:
+        """Prefix-cache occupancy and sharing for /statusz — host dicts
+        only, safe from the statusz thread.  None on dense engines."""
+        if not self.paged:
+            return None
+        pool = self._pool.stats()
+        hits, lookups = self.prefix_hits, self.prefix_lookups
+        return {
+            "prefix_hits": hits,
+            "prefix_lookups": lookups,
+            "hit_rate": (hits / lookups) if lookups else 0.0,
+            "pages_shared": pool["shared_pages"],
+            "cached_pages": pool["cached_pages"],
+            "free_pages": pool["free_pages"],
+            "capacity": pool["capacity"],
+            "fork_groups": self.fork_groups,
+        }
+
+    def prefix_digest(self) -> dict | None:
+        """Compact advertisement of this engine's cached prefixes for
+        fleet-scope routing (rides worker heartbeat/stats frames); None
+        on dense engines, which cache nothing."""
+        if not self.paged:
+            return None
+        return self._pool.prefix_digest()
+
+    def _publish_cache_gauges(self) -> None:
+        """Mirror cache counters into registry gauges so heartbeats and
+        /metricsz carry per-worker hit-rate inputs without a bench run."""
+        registry = _metrics.get_registry()
+        registry.gauge("engine.prefix_hits").set(self.prefix_hits)
+        registry.gauge("engine.prefix_lookups").set(self.prefix_lookups)
+        registry.gauge("engine.prefix_pages_shared").set(
+            self._pool.shared_pages)
+        registry.gauge("engine.pool_free_pages").set(self._pool.free_pages)
+        registry.gauge("engine.pool_pages_in_use").set(
+            self._pool.capacity - self._pool.free_pages)
 
     def qos_status(self) -> dict:
         """Per-class / per-tenant queue + in-flight occupancy and the
@@ -2509,6 +2702,8 @@ class ServingEngine:
             out["evictions"] = self.evictions
             out["pause_events"] = self.pause_events
             out["prefix_hits"] = self.prefix_hits
+            out["prefix_lookups"] = self.prefix_lookups
+            out["fork_groups"] = self.fork_groups
             out["pool"] = self._pool.stats()
         if self.disagg:
             out["handoff"] = self._handoff.stats()

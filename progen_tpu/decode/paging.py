@@ -52,6 +52,18 @@ def pages_for_span(last_row: int, page_size: int) -> int:
     return last_row // page_size + 1
 
 
+def token_span_digest(tokens: Sequence[int], upto: int) -> str:
+    """Content hash of the first ``upto`` prime tokens.  Shared between
+    ``prefix_key`` (pool-local identity) and the fleet router's digest
+    matching: the router scores replicas by ``(upto, digest)`` alone, so
+    it can rank placements without knowing which prefill bucket a worker
+    will land the request in."""
+    h = hashlib.blake2b(digest_size=16)
+    for t in tokens[:upto]:
+        h.update(b"%d," % int(t))
+    return h.hexdigest()
+
+
 def prefix_key(p_pad: int, tokens: Sequence[int], upto: int) -> tuple:
     """Hash key for the prefix page covering rows ``[upto-page_size,
     upto)``: the first ``upto`` prime tokens plus the padded prefill
@@ -60,11 +72,7 @@ def prefix_key(p_pad: int, tokens: Sequence[int], upto: int) -> tuple:
     same-shape prefill program (same summation trees); two requests whose
     primes land in different prefill buckets recompute rather than share.
     """
-    h = hashlib.blake2b(digest_size=16)
-    h.update(b"%d|%d|" % (p_pad, upto))
-    for t in tokens[:upto]:
-        h.update(b"%d," % int(t))
-    return (p_pad, upto, h.hexdigest())
+    return (p_pad, upto, token_span_digest(tokens, upto))
 
 
 @dataclasses.dataclass
@@ -212,6 +220,30 @@ class PagePool:
 
     # ---------------------------------------------------------------- stats
 
+    @property
+    def shared_pages(self) -> int:
+        """Page-holder edges beyond the index's own reference: a cached
+        page referenced by ``k`` in-flight requests contributes ``k``.
+        Zero when nothing is actively sharing."""
+        return sum(self._ref.get(pid, 0) - 1
+                   for pid in self._prefix.values()
+                   if self._ref.get(pid, 0) > 1)
+
+    def prefix_digest(self) -> dict:
+        """Compact JSON-safe advertisement of cache contents for the
+        fleet router: one ``[p_pad, upto, digest, refcount]`` row per
+        cached prefix page in LRU order (coldest first), plus pool
+        pressure.  Cheap enough to ride every heartbeat — the index is
+        bounded by the pool size."""
+        return {
+            "page_size": self.page_size,
+            "keys": [[k[0], k[1], k[2], self._ref.get(pid, 0)]
+                     for k, pid in self._prefix.items()],
+            "free": self.free_pages,
+            "cached": self.cached_pages,
+            "capacity": self.capacity,
+        }
+
     def stats(self) -> dict[str, int]:
         """Host-side accounting snapshot (robustness/chaos records)."""
         return {
@@ -219,5 +251,6 @@ class PagePool:
             "capacity": self.capacity,
             "free_pages": self.free_pages,
             "cached_pages": self.cached_pages,
+            "shared_pages": self.shared_pages,
             "pages_in_use": self.capacity - self.free_pages,
         }

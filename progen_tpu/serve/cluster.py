@@ -89,14 +89,17 @@ class ServeCluster:
     def __init__(self, spec: dict, *, prefill_procs: int = 1,
                  replicas: int = 1, supervisor: StageSupervisor | None = None,
                  spawn_timeout: float = 300.0, stale_after: float = 300.0,
-                 log_dir: str | None = None):
+                 log_dir: str | None = None, route_by_cache: bool = True):
         self.spec = spec
         self.prefill_procs = prefill_procs
         self.replicas = replicas
         self.supervisor = supervisor or StageSupervisor(max_restarts=1)
         self.stale_after = stale_after
         self.counters = TransportCounters()  # router-side, all peers
-        self.router = Router(prefill_procs, replicas)
+        self.router = Router(prefill_procs, replicas,
+                             route_by_cache=route_by_cache)
+        self._ttft: dict = {}                # uid -> driver-clock TTFT (s)
+        self._cache_counts: dict = {}        # replica -> (hits, lookups)
         self.completions: dict = {}          # uid -> Completion
         self._new: list[Completion] = []
         self._events: _queue.Queue = _queue.Queue()
@@ -547,6 +550,9 @@ class ServeCluster:
             self._note_clock(peer.role, peer.index, header.get("clock"))
             header["age_clock"] = time.perf_counter()
             self._hb[(peer.role, peer.index)] = header
+            if peer.role == "decode" and "digest" in header:
+                self._note_cache_frame(peer.index, header,
+                                       header["age_clock"])
         elif t == "ready":
             # staleness starts here: until ready, the worker is inside
             # its engine build (cold jit can run minutes heartbeat-free)
@@ -588,6 +594,9 @@ class ServeCluster:
                 # bookkeeping), not whatever the cluster serves now —
                 # in-flight requests finish on their own generation
                 comp.generation = self.router.generation_of(uid)
+                ttft = self._ttft.pop(uid, None)
+                if ttft is not None and submit:
+                    comp.first_token_time = submit + ttft
                 self.completions[uid] = comp
                 self._new.append(comp)
                 # the one end-to-end latency code path: the same
@@ -603,6 +612,10 @@ class ServeCluster:
             self._note_clock(peer.role, peer.index, header.get("clock"))
             self._worker_stats[(peer.role, peer.index)] = header
             self._stats_age[(peer.role, peer.index)] = time.perf_counter()
+            if peer.role == "decode" and "digest" in header:
+                self._note_cache_frame(
+                    peer.index, header,
+                    self._stats_age[(peer.role, peer.index)])
 
     def _on_hello(self, peer: Peer, header: dict) -> None:
         # index arrives as a JSON int from the worker's hello; no cast —
@@ -633,6 +646,52 @@ class ServeCluster:
                 now = time.perf_counter()
                 for uid in parked:
                     self._dispatch(uid, now)
+
+    def _note_cache_frame(self, idx: int, header: dict, at: float) -> None:
+        """Feed a decode worker's cache advertisement into the router's
+        digest table, mirror its cache gauges as per-worker LABELED
+        driver metrics, and refresh the derived fleet hit-rate gauge —
+        so the driver's /statusz shows the fleet cache picture without
+        a bench run."""
+        self.router.note_digest(idx, header["digest"], at)
+        m = header.get("metrics") or {}
+        registry = _metrics.get_registry()
+        vals = {}
+        for name in ("engine.prefix_hits", "engine.prefix_lookups",
+                     "engine.prefix_pages_shared",
+                     "engine.pool_free_pages",
+                     "engine.pool_pages_in_use"):
+            snap = m.get(name)
+            if isinstance(snap, dict) and "value" in snap:
+                vals[name] = snap["value"]
+                registry.gauge(_metrics.labeled(
+                    name, role="decode", idx=idx)).set(snap["value"])
+        if "engine.prefix_lookups" in vals:
+            self._cache_counts[idx] = (
+                vals.get("engine.prefix_hits", 0.0),
+                vals["engine.prefix_lookups"])
+        hits = sum(h for h, _ in self._cache_counts.values())
+        lookups = sum(n for _, n in self._cache_counts.values())
+        registry.gauge("cluster.fleet_prefix_hit_rate").set(
+            (hits / lookups) if lookups else 0.0)
+
+    def cache_stats(self) -> dict:
+        """Fleet cache view for records and /statusz: summed per-replica
+        hit counters, the router's routing tallies, and the per-replica
+        cache VALUE the scale-down policy consumes."""
+        hits = sum(h for h, _ in self._cache_counts.values())
+        lookups = sum(n for _, n in self._cache_counts.values())
+        return {
+            "fleet_prefix_hits": hits,
+            "fleet_prefix_lookups": lookups,
+            "fleet_prefix_hit_rate": (hits / lookups) if lookups else 0.0,
+            "route_by_cache": self.router.route_by_cache,
+            "cache_routed": self.router.cache_routed,
+            "cache_fallback": self.router.cache_fallback,
+            "cache_overridden": self.router.cache_overridden,
+            "replica_cache_value": self.router.cache_summary(
+                time.perf_counter()),
+        }
 
     def _note_clock(self, role, idx, clock) -> None:
         """Refine the (role, idx) worker's perf_counter offset from a
@@ -668,9 +727,22 @@ class ServeCluster:
         batch_id = header.get("batch_id")
         uids = [d["uid"] for d in header.get("reqs", [])]
         self.router.note_handle(batch_id, uids, peer.index)
+        # the handle carries each request's first sampled token, so its
+        # arrival is the driver-observed TTFT (submit and arrival are
+        # both driver clock — no cross-process correction needed); a
+        # replayed handle keeps the first stamp, when the token first
+        # existed
+        for uid in uids:
+            st = self.router.submit_times.get(uid)
+            if st is not None:
+                self._ttft.setdefault(uid, t0 - st)
         # per-generation placement: state primed on gen-G weights may
         # only decode on a gen-G replica (swap correctness/determinism)
-        r = self.router.pick_replica(self.router.batch_generation(batch_id))
+        tokens_batch = [self.router.requests[uid].tokens
+                        for uid in uids if uid in self.router.requests]
+        r = self.router.pick_replica(
+            self.router.batch_generation(batch_id),
+            tokens_batch=tokens_batch, now=t0)
         if r is None:
             # this batch will never reach replica admission: return its
             # credit before parking/shedding the member requests
@@ -684,7 +756,7 @@ class ServeCluster:
                 for uid in self.router.requeue(uids):
                     self._shed(uid, FAILED_FAULT, now)
             return
-        self.router.forward(batch_id, r)
+        self.router.forward(batch_id, r, t0)
         rp = self._peers.get(("decode", r))
         if rp is not None and rp.alive:
             rp.send_bytes(frame)  # verbatim relay: payload is zero-copy
@@ -928,6 +1000,7 @@ class ServeCluster:
                              for r, i in self._pending_routable)},
             **({"statusz_ports": statusz_ports} if statusz_ports else {}),
             "router": self.router.stats(),
+            "cache": self.cache_stats(),
             "router_transport": self.counters.as_dict(),
             "transport_total": total.as_dict(),
             "workers": per_worker,

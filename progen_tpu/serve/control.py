@@ -143,6 +143,7 @@ class ControlPlane:
             queued_uids=len(c._parked_uids),
             stage_seconds=stage_seconds,
             queued_by_class=c.router.queued_by_class(),
+            replica_cache=c.router.cache_summary(now),
         )
 
     # ----------------------------------------------------------------- ticks
@@ -164,6 +165,8 @@ class ControlPlane:
             "replica_outstanding": dict(inputs.replica_outstanding),
             "queued_uids": inputs.queued_uids,
             "queued_by_class": dict(inputs.queued_by_class),
+            "replica_cache": {i: dict(v) for i, v in
+                              inputs.replica_cache.items()},
         }
         added = []
         for d in self.policy.decide(inputs):
@@ -187,20 +190,45 @@ class ControlPlane:
         return added
 
     def _pick_victim(self, role: str) -> int | None:
-        """Least-loaded placeable instance of ``role`` (never one still
-        warming up, never one already fenced)."""
+        """Scale-down victim (never one still warming up, never one
+        already fenced).  Prefill: least queued.  Decode: CACHE-VALUED —
+        among replicas with a fresh digest, evict the one whose cached
+        pages are coldest/most-duplicated (lowest cache value, load as
+        tie-break) and NEVER the sole live holder of a hot (actively
+        shared) prefix; when every fresh replica is a sole holder, a
+        stale-digest replica is sacrificed on load alone (its contents
+        are unknown, not known-precious); when no digest is fresh the
+        selection degrades to the pre-cache load-only rule.  Returns
+        None when nothing is safely evictable."""
         r = self.cluster.router
         if role == "prefill":
-            live = r._placeable_prefill()
-            load = r.prefill_load
-        else:
-            live = r._placeable_replicas()
-            load = r.outstanding
-        live = {i for i in live
+            live = {i for i in r._placeable_prefill()
+                    if (role, i) not in self.cluster._pending_routable}
+            if len(live) <= 1:
+                return None
+            return min(sorted(live), key=lambda i: r.prefill_load.get(i, 0))
+        live = {i for i in r._placeable_replicas()
                 if (role, i) not in self.cluster._pending_routable}
         if len(live) <= 1:
             return None
-        return min(sorted(live), key=lambda i: load.get(i, 0))
+        summary = r.cache_summary(time.perf_counter())
+
+        def ent(i):
+            return summary.get(i, {"stale": True, "value": 0.0,
+                                   "sole_hot": False})
+
+        fresh = [i for i in sorted(live) if not ent(i)["stale"]]
+        if not fresh:
+            # no cache knowledge at all: the pre-cache load-only rule
+            return min(sorted(live), key=lambda i: r.outstanding.get(i, 0))
+        cand = [i for i in fresh if not ent(i)["sole_hot"]]
+        if cand:
+            return min(cand, key=lambda i: (ent(i)["value"],
+                                            r.outstanding.get(i, 0)))
+        stale = [i for i in sorted(live) if ent(i)["stale"]]
+        if stale:
+            return min(stale, key=lambda i: r.outstanding.get(i, 0))
+        return None  # every replica is the sole holder of a hot prefix
 
     # ------------------------------------------------------------------ swap
 

@@ -49,7 +49,13 @@ class PolicyInputs:
     stage cost.  ``queued_by_class`` maps priority class -> fleet-wide
     queued-at-prefill count (docs/SERVING.md §10) — journaled with
     every decision, and available to QoS-aware policies that scale on
-    high-class backlog rather than total depth."""
+    high-class backlog rather than total depth.  ``replica_cache`` maps
+    replica index -> ``{"value", "sole_hot", "stale"}`` from the
+    router's cache digest table (docs/SERVING.md §11): the control
+    plane's scale-down victim selection consumes it (evict the
+    coldest/most-duplicated cache, never the sole holder of a hot
+    prefix), and it is journaled so every scale-down is attributable
+    to the cache picture it saw."""
 
     now: float
     prefill_workers: int
@@ -60,6 +66,7 @@ class PolicyInputs:
     queued_uids: int = 0
     stage_seconds: dict = dataclasses.field(default_factory=dict)
     queued_by_class: dict = dataclasses.field(default_factory=dict)
+    replica_cache: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)

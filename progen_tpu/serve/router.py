@@ -9,10 +9,15 @@ Policy:
 
 - requests go to the LEAST-LOADED live prefill worker (queued-request
   count — prefill cost is per request, not per token);
-- handles go to the LEAST-OUTSTANDING-TOKENS live replica (the decode
-  budget a replica is still on the hook for: sum of ``max_new_tokens``
-  forwarded minus completed), the closest proxy for remaining decode
-  work without a device sync;
+- handles go to the live replica holding the LONGEST CACHED PREFIX of
+  the batch's requests (scored against the per-replica digest table the
+  workers advertise on heartbeats, plus an optimistic overlay for
+  handles forwarded since the last digest), ties and cache misses
+  broken by LEAST OUTSTANDING TOKENS (the decode budget a replica is
+  still on the hook for: sum of ``max_new_tokens`` forwarded minus
+  completed) — a digest older than ``digest_ttl`` is STALE and scores
+  zero, so a silent worker degrades to the load-only policy rather
+  than attracting traffic on dead information;
 - every request's stage is tracked (``prefill → handle → replica``), so
   a dead stage maps to exactly the uids whose work it held:
   :meth:`fail_worker` returns them for replay (seed determinism makes
@@ -30,14 +35,25 @@ across a rolling weight swap.
 
 from __future__ import annotations
 
+from progen_tpu.decode.paging import token_span_digest
+
 
 class Router:
     """Placement + lifecycle bookkeeping for one serving cluster."""
 
-    def __init__(self, prefill_workers: int, replicas: int):
+    def __init__(self, prefill_workers: int, replicas: int, *,
+                 route_by_cache: bool = True, digest_ttl: float = 5.0,
+                 cache_imbalance_tokens: int = 32):
         if prefill_workers < 1 or replicas < 1:
             raise ValueError("need at least one prefill worker and one "
                              "replica")
+        self.route_by_cache = bool(route_by_cache)
+        self.digest_ttl = float(digest_ttl)
+        # affinity load guard: a cache-holding replica may run at most
+        # this many outstanding tokens AHEAD of the least-loaded one
+        # before placement reverts to load-only — affinity must never
+        # serialize the fleet onto one hot replica
+        self.cache_imbalance_tokens = int(cache_imbalance_tokens)
         self.prefill_alive = set(range(prefill_workers))
         self.replica_alive = set(range(replicas))
         self.prefill_fenced: set = set()  # alive but not placeable (draining)
@@ -59,6 +75,20 @@ class Router:
         self.submit_times: dict = {}      # uid -> router perf_counter instant
         self.max_prefill_queue = 0
         self.max_outstanding = 0
+        # replica -> {"keys": {(upto, digest): refcount}, "at": clock,
+        # "page_size", "free", "cached", "capacity"} — last advertised
+        # cache digest; "at" is on the ROUTER clock (the cluster stamps
+        # arrival), so staleness needs no cross-process clock agreement
+        self.replica_digest: dict = {}
+        # replica -> {(upto, digest): forwarded-at}: prefixes we just
+        # routed there and EXPECT cached before the next digest lands —
+        # keeps back-to-back same-prefix placements sticky instead of
+        # oscillating on heartbeat cadence
+        self._optimistic: dict = {}
+        self._page_size_hint = 0
+        self.cache_routed = 0
+        self.cache_fallback = 0
+        self.cache_overridden = 0
 
     # ------------------------------------------------------------- placement
 
@@ -87,18 +117,99 @@ class Router:
         return min(sorted(live),
                    key=lambda w: (contending(w), self.prefill_load[w]))
 
-    def pick_replica(self, generation: int | None = None) -> int | None:
-        """Least-outstanding-tokens live, unfenced replica.  With
-        ``generation`` set, only replicas serving that weight generation
-        qualify — a handle primed on gen-G weights must decode on gen-G
-        weights or determinism (and the swap contract) breaks."""
+    def pick_replica(self, generation: int | None = None, *,
+                     tokens_batch=None,
+                     now: float | None = None) -> int | None:
+        """Longest-cached-prefix live, unfenced replica (least
+        outstanding tokens as tie-break and as the fallback when no
+        fresh digest matches anything).  With ``generation`` set, only
+        replicas serving that weight generation qualify — a handle
+        primed on gen-G weights must decode on gen-G weights or
+        determinism (and the swap contract) breaks.  ``tokens_batch``
+        is the token sequences riding the handle; cache scoring needs
+        it and ``now`` (router clock) — without them, or with
+        ``route_by_cache=False``, placement is load-only.  Placement is
+        a PERFORMANCE hint: a mispredicted hit costs pool pages, never
+        tokens."""
         live = self._placeable_replicas()
         if generation is not None:
             live = {r for r in live
                     if self.replica_gen.get(r, 0) == generation}
         if not live:
             return None
-        return min(sorted(live), key=lambda r: self.outstanding[r])
+        order = sorted(live)
+        if self.route_by_cache and tokens_batch and now is not None:
+            scores = {r: self._cache_score(r, tokens_batch, now)
+                      for r in order}
+            best = max(scores.values())
+            if best > 0:
+                cand = [r for r in order if scores[r] == best]
+                pick = min(cand, key=lambda r: self.outstanding[r])
+                least = min(order, key=lambda r: self.outstanding[r])
+                if (self.outstanding[pick] - self.outstanding[least]
+                        <= self.cache_imbalance_tokens):
+                    self.cache_routed += 1
+                    return pick
+                # the cache holder is too far ahead of the least-loaded
+                # replica: spill there instead — a cold prefill beats a
+                # hot queue
+                self.cache_overridden += 1
+                return least
+            self.cache_fallback += 1
+        return min(order, key=lambda r: self.outstanding[r])
+
+    def _cache_score(self, replica: int, tokens_batch, now: float) -> int:
+        """Pages of the batch's primes already cached on ``replica``:
+        for each request, the longest CONTIGUOUS run of full prime pages
+        present in the replica's advertised (or optimistic) key set —
+        the same run the engine's planner can actually share.  A stale
+        digest scores 0 (fallback contract)."""
+        ent = self.replica_digest.get(replica)
+        keys = {}
+        if ent is not None and now - ent["at"] <= self.digest_ttl:
+            keys = ent["keys"]
+        opt = self._optimistic.get(replica, {})
+        ps = (ent or {}).get("page_size") or self._page_size_hint
+        if not ps or (not keys and not opt):
+            return 0
+        score = 0
+        for tokens in tokens_batch:
+            for j in range(1, len(tokens) // ps + 1):
+                k = (j * ps, token_span_digest(tokens, j * ps))
+                if k in keys:
+                    score += 1
+                elif k in opt and now - opt[k] <= self.digest_ttl:
+                    score += 1
+                else:
+                    break
+        return score
+
+    def note_digest(self, index: int, digest: dict, now: float) -> None:
+        """Install a replica's freshly advertised cache digest.  Keys
+        collapse to ``(upto, token-digest)`` — the prefill bucket
+        (``p_pad``) in the pool's key is dropped, because at routing
+        time the handle's bucket is already fixed and a bucket-mismatch
+        "hit" merely degrades to a fresh allocation on the replica.
+        Fresh truth supersedes the optimistic overlay."""
+        keys: dict = {}
+        for row in digest.get("keys", ()):
+            _p_pad, upto, dg, ref = row
+            k = (int(upto), dg)
+            keys[k] = max(keys.get(k, 0), int(ref))
+        ps = int(digest.get("page_size", 0))
+        if ps:
+            self._page_size_hint = ps
+        self.replica_digest[index] = {
+            "keys": keys, "at": float(now), "page_size": ps,
+            "free": int(digest.get("free", 0)),
+            "cached": int(digest.get("cached", 0)),
+            "capacity": int(digest.get("capacity", 0)),
+        }
+        opt = self._optimistic.get(index)
+        if opt:
+            for k in list(opt):
+                if k in keys or now - opt[k] > self.digest_ttl:
+                    del opt[k]
 
     # ------------------------------------------------------------- lifecycle
 
@@ -145,8 +256,13 @@ class Router:
                 self._dec_prefill(src, uid)
             self.stage[uid] = ("handle", batch_id)
 
-    def forward(self, batch_id: str, replica: int) -> None:
-        """The router relayed the handle frame to ``replica``."""
+    def forward(self, batch_id: str, replica: int,
+                now: float | None = None) -> None:
+        """The router relayed the handle frame to ``replica``.  With
+        ``now`` set and cache routing on, the batch's full prime pages
+        enter the replica's optimistic overlay — the replica will cache
+        them on admission, and waiting a heartbeat to learn that would
+        scatter a same-prefix burst across the fleet."""
         b = self.batches[batch_id]
         b["replica"] = replica
         for uid in b["uids"]:
@@ -155,6 +271,12 @@ class Router:
             self.stage[uid] = ("replica", replica)
             r = self.requests[uid]
             self.outstanding[replica] += int(r.max_new_tokens)
+            ps = self._page_size_hint
+            if now is not None and self.route_by_cache and ps:
+                opt = self._optimistic.setdefault(replica, {})
+                for j in range(1, len(r.tokens) // ps + 1):
+                    opt.setdefault(
+                        (j * ps, token_span_digest(r.tokens, j * ps)), now)
         self.max_outstanding = max(self.max_outstanding,
                                    self.outstanding[replica])
 
@@ -271,6 +393,8 @@ class Router:
             self.replica_fenced.discard(index)
             self.replica_gen.pop(index, None)
             self.outstanding.pop(index, None)
+            self.replica_digest.pop(index, None)
+            self._optimistic.pop(index, None)
 
     def generation_of(self, uid) -> int:
         """Weight generation of the prefill pass that primed ``uid``
@@ -317,6 +441,9 @@ class Router:
                     affected.append(uid)
             if index in self.outstanding:
                 self.outstanding[index] = 0
+            # a dead replica's cache died with it
+            self.replica_digest.pop(index, None)
+            self._optimistic.pop(index, None)
         return self.requeue(affected)
 
     def revive_worker(self, role: str, index: int) -> None:
@@ -327,6 +454,46 @@ class Router:
         else:
             self.replica_alive.add(index)
             self.outstanding[index] = 0
+
+    # ------------------------------------------------------------ cache value
+
+    def cache_summary(self, now: float) -> dict:
+        """Per-replica cache VALUE for scale-down victim selection:
+
+        - ``value``: sum over the replica's cached prefixes of
+          ``refcount / holders`` — a page many in-flight requests share
+          and no other replica holds is worth the most; an idle page
+          duplicated fleet-wide is worth the least;
+        - ``sole_hot``: the replica is the ONLY live holder of some HOT
+          prefix (refcount >= 2, i.e. actively shared by in-flight
+          work) — retiring it would force every future hit on that
+          prefix to re-prime;
+        - ``stale``: no digest fresher than ``digest_ttl`` — cache
+          contents unknown, so the caller must not credit (or debit)
+          this replica on cache grounds.
+        """
+        holders: dict = {}
+        fresh: dict = {}
+        for r in sorted(self.replica_alive):
+            ent = self.replica_digest.get(r)
+            if ent is None or now - ent["at"] > self.digest_ttl:
+                continue
+            fresh[r] = ent
+            for k in ent["keys"]:
+                holders[k] = holders.get(k, 0) + 1
+        out: dict = {}
+        for r in sorted(self.replica_alive):
+            ent = fresh.get(r)
+            if ent is None:
+                out[r] = {"stale": True, "value": 0.0, "sole_hot": False}
+                continue
+            value = sum(ref / holders[k]
+                        for k, ref in ent["keys"].items())
+            sole_hot = any(ref >= 2 and holders[k] == 1
+                           for k, ref in ent["keys"].items())
+            out[r] = {"stale": False, "value": round(value, 6),
+                      "sole_hot": sole_hot}
+        return out
 
     # ----------------------------------------------------------------- stats
 
@@ -358,4 +525,9 @@ class Router:
             "open_batches": len(self.batches),
             "submitted": len(self.requests),
             "completed": len(self.completed),
+            "route_by_cache": self.route_by_cache,
+            "cache_routed": self.cache_routed,
+            "cache_fallback": self.cache_fallback,
+            "cache_overridden": self.cache_overridden,
+            "replicas_with_digest": sorted(self.replica_digest),
         }

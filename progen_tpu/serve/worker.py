@@ -181,6 +181,11 @@ def _stats_frame(eng, counters, **extra) -> dict:
            # per-class/per-tenant counters are the fleet QoS view
            "robust": eng.robustness_counters(),
            "metrics": get_registry().snapshot()}
+    dig = eng.prefix_digest()
+    if dig is not None:
+        # cache advertisement rides the stats frame too, so a drain-time
+        # flush leaves the router's digest table current
+        msg["digest"] = dig
     msg.update(extra)
     return msg
 
@@ -257,6 +262,9 @@ def _prefill_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
                                       "src_proc": f"prefill:{peer.index}"}})
                 unacked.add(batch_id)
                 peer.send_bytes(frame)
+                # handed-off requests are harvested by a decode replica,
+                # never here — drop their first-token stamps
+                eng.forget_ttft(r.uid for r in h.requests)
             elif eng.pending >= before:
                 break  # no progress (should not happen; avoid spinning)
         now = time.perf_counter()
@@ -328,12 +336,16 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
         now = time.perf_counter()
         if now - last_hb >= heartbeat_s:
             last_hb = now
-            peer.send_json({
+            hb_msg = {
                 "type": "hb", "inflight": eng.num_active,
                 "handoff_backlog": len(backlog),
                 "clock": now,
                 "stage_seconds": eng.stage_seconds,
-                "metrics": get_registry().snapshot()})
+                "metrics": get_registry().snapshot()}
+            dig = eng.prefix_digest()
+            if dig is not None:
+                hb_msg["digest"] = dig
+            peer.send_json(hb_msg)
     peer.send_json(_stats_frame(eng, counters,
                                 max_handoff_backlog=max_backlog))
 

@@ -122,6 +122,35 @@ def test_chaos_paged_token_identity(trained, clean):
         eng._pool.capacity
 
 
+def test_chaos_fork_page_alloc_rollback(trained):
+    """submit_fork under a page_alloc fault: the leader's admission
+    defers and retries, the held followers still land as cache hits (or
+    unshared after a shed — either way token-identical to the fault-free
+    fork run), and the pool closes its books — a rolled-back alloc must
+    not strand a fork group or leak a page reference."""
+    _, params, policy = trained
+    base = Request(uid=0, tokens=[3, 1, 4, 1, 5, 9, 2, 6],
+                   max_new_tokens=6, top_k=8, temperature=0.9, seed=100)
+
+    def run(plan):
+        faults.configure(plan, seed=5)
+        eng = ServingEngine(CFG, params, policy=policy, num_slots=2,
+                            chunk_size=4, max_len=20, paged=True,
+                            page_size=4)
+        eng.submit_fork(base, 3)
+        comps = eng.run_until_idle(max_chunks=300)
+        return eng, {c.uid: (c.tokens.tolist(), c.status) for c in comps}
+
+    _, clean_forks = run("")
+    eng, out = run("serve.page_alloc:io_error:at=2")
+    assert out == clean_forks
+    assert eng.robust.faults_contained >= 1
+    assert eng.robust.failed_faults == 0
+    assert eng._pool.shared_pages == 0
+    assert eng._pool.free_pages + eng._pool.cached_pages == \
+        eng._pool.capacity
+
+
 def test_fatal_fault_sheds_typed_completion(trained, clean):
     """A non-transient fault never raises out of the engine: the affected
     requests become ``failed_fault`` completions, everyone else finishes

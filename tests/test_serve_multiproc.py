@@ -327,6 +327,7 @@ def _bare_cluster(prefill=1, replicas=1, max_restarts=0):
     c._handled_dead, c._respawning = set(), set()
     c._parked_uids, c._worker_stats, c._hb = [], {}, {}
     c._stats_age, c._clock_offsets = {}, {}
+    c._ttft, c._cache_counts = {}, {}
     c.generation = 0
     c._worker_gen = {("prefill", i): 0 for i in range(prefill)}
     c._worker_gen.update({("decode", i): 0 for i in range(replicas)})

@@ -62,6 +62,12 @@ WATCHED: dict[str, tuple[int, float]] = {
     # any drift is a scheduling change, not noise
     "qos_fairness_index": (+1, 0.02),
     "hi_p95_latency_v": (-1, 0.02),
+    # fleet prefix cache (bench_serving.py --zipf --serve-procs): the
+    # hit rate is near-deterministic for a fixed Zipf schedule (band
+    # covers heartbeat/eviction timing); TTFT is a wall-clock measure
+    # on shared runners, so its band stays wide
+    "fleet_prefix_hit_rate": (+1, 0.25),
+    "ttft_p95": (-1, 0.50),
 }
 
 

@@ -136,6 +136,40 @@ if python tools/benchdiff.py --metric serving_qos \
     exit 1
 fi
 
+echo "== fleetcache smoke =="
+# fleet prefix cache on a real cluster (prefill worker + 2 decode
+# replicas): a Zipf popular-prompt schedule runs cache-aware vs
+# cache-blind on the SAME arrivals under a tight page pool; --verify
+# asserts both clusters are token-identical to the in-process engine
+# (placement is a perf hint, never a correctness input); the record's
+# fleet_prefix_hit_rate / ttft_p95 feed the benchdiff gate
+# (docs/SERVING.md §11)
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 8 --rate 50 --slots 2 --chunk 4 \
+    --max-new 6 --prime-min 8 --prime-max 12 \
+    --paged --page-size 4 --num-pages 24 \
+    --serve-procs --replicas 2 --zipf 1.1 --zipf-pool 4 \
+    --verify --out "$BENCH_DIR/fleetcache.jsonl"
+# self-diff must pass; an injected cache regression (hit rate collapse
+# + TTFT blowup) must FAIL — the gate that catches a routing or
+# digest-plumbing regression before it ships
+python tools/benchdiff.py --metric serving_fleetcache \
+    "$BENCH_DIR/fleetcache.jsonl" "$BENCH_DIR/fleetcache.jsonl"
+python - "$BENCH_DIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+rec = json.loads(open(f"{d}/fleetcache.jsonl").readline())
+rec["fleet_prefix_hit_rate"] = rec["fleet_prefix_hit_rate"] * 0.3  # cache miss storm
+rec["ttft_p95"] = rec["ttft_p95"] * 5 + 1.0                        # first-token blowup
+rec["wall_time"] = rec.get("wall_time", 0) + 1
+open(f"{d}/fleetcache_bad.jsonl", "w").write(json.dumps(rec) + "\n")
+EOF
+if python tools/benchdiff.py --metric serving_fleetcache \
+        "$BENCH_DIR/fleetcache.jsonl" "$BENCH_DIR/fleetcache_bad.jsonl"; then
+    echo "benchdiff FAILED to flag an injected fleetcache regression" >&2
+    exit 1
+fi
+
 echo "== elastic-serving smoke =="
 # elastic control plane on a real cluster: a bursty schedule forces a
 # scale-up (warm-before-routable), plus a rolling LoRA hot-swap mid-run;
