@@ -82,6 +82,7 @@ high class beat FIFO, and that no nonzero-weight tenant starved
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import sys
@@ -139,6 +140,20 @@ def main() -> None:
                          "to the SAME modeled gate-cache HBM as a "
                          "fixed-slot engine with this many slots "
                          "(equal-budget comparison)")
+    ap.add_argument("--quantize", choices=("weights", "weights+pages"),
+                    default=None,
+                    help="opt-in int8 serving: 'weights' re-types dense "
+                         "kernels and SGU spatial weights to int8 (f32 "
+                         "per-channel scales); 'weights+pages' also stores "
+                         "the paged gate cache as 8-bit pages (needs "
+                         "--paged).  Emits a serving_quant record PLUS a "
+                         "serving_quant_full full-precision record driven "
+                         "on the identical schedule (same schedule_hash), "
+                         "so benchdiff compares like with like")
+    ap.add_argument("--match-gate", type=float, default=0.98,
+                    help="with --quantize --verify: minimum greedy "
+                         "token-match rate vs the full-precision engine "
+                         "(the accuracy-verify tier, docs/SERVING.md §12)")
     ap.add_argument("--spec", action="store_true",
                     help="speculative decoding: draft-propose/target-"
                          "verify rounds instead of single-token steps "
@@ -316,6 +331,15 @@ def main() -> None:
     toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
     params = unbox(jax.jit(model.init)(jax.random.key(0), toks))
 
+    if args.quantize:
+        if (args.serve_procs or args.chaos or args.scenario_mix
+                or args.trace_file):
+            raise SystemExit("--quantize drives one in-process engine "
+                             "pair; drop --serve-procs/--chaos/"
+                             "--scenario-mix/--trace-file")
+        if args.quantize == "weights+pages" and not args.paged:
+            raise SystemExit("--quantize weights+pages requires --paged")
+
     if args.trace_file:
         if (args.spec or args.disagg or args.serve_procs or args.chaos
                 or args.scenario_mix):
@@ -396,6 +420,15 @@ def main() -> None:
             scaffolds[uid] = ScaffoldSpec(template=tmpl,
                                           vocab=cfg.num_tokens)
 
+    # fingerprint of everything that determines the token streams being
+    # compared: quant records carry it so benchdiff never diffs
+    # token_match_rate (or throughput) across DIFFERENT schedules
+    sched_hash = hashlib.blake2b(json.dumps({
+        "config": args.config, "requests": args.requests,
+        "seed": args.seed, "rate": args.rate, "max_new": args.max_new,
+        "specs": specs, "workloads": workloads,
+    }, sort_keys=True).encode(), digest_size=8).hexdigest()
+
     def make_request(uid: int, submit_time: float,
                      ttl: float | None = None) -> Request:
         common = dict(uid=uid, top_k=25, temperature=1.0,
@@ -416,12 +449,20 @@ def main() -> None:
 
     max_len = args.max_len or min(cfg.seq_len, pmax + args.max_new + 1)
     num_pages = args.num_pages
+    num_pages_fp = args.num_pages
     if args.paged and num_pages is None and args.budget_slots is not None:
         from progen_tpu.train.memory import equal_budget_pages
 
+        # the SAME byte budget buys ~2x the pages at int8 — that is the
+        # equal-HBM capacity the serving_quant record reports
+        gd = "int8" if args.quantize == "weights+pages" else "bf16"
         num_pages = equal_budget_pages(cfg, dense_slots=args.budget_slots,
                                        max_len=max_len,
-                                       page_size=args.page_size)
+                                       page_size=args.page_size,
+                                       gate_dtype=gd)
+        num_pages_fp = equal_budget_pages(
+            cfg, dense_slots=args.budget_slots, max_len=max_len,
+            page_size=args.page_size, gate_dtype="bf16")
     paged_kwargs = dict(
         paged=True, page_size=args.page_size, num_pages=num_pages,
         paged_impl=args.paged_impl, prefix_cache=not args.no_prefix_cache,
@@ -451,8 +492,15 @@ def main() -> None:
 
     def mk_engine(*, robust: bool, use_spec: bool | None = None,
                   use_disagg: bool | None = None,
-                  use_lora: bool = True) -> ServingEngine:
+                  use_lora: bool = True,
+                  use_quant: bool = True) -> ServingEngine:
         kw = dict(paged_kwargs)
+        if args.quantize and use_quant:
+            kw["quantize"] = args.quantize
+        elif args.paged:
+            # the full-precision reference holds the SAME byte budget,
+            # which at bf16 rows means fewer pages
+            kw["num_pages"] = num_pages_fp
         if use_spec if use_spec is not None else args.spec:
             kw.update(spec_kwargs)
         if use_disagg if use_disagg is not None else args.disagg:
@@ -557,7 +605,10 @@ def main() -> None:
                         num_pages=num_pages,
                         lora_tenants=(args.lora_tenants if lora_kwargs
                                       else 0),
-                        lora_rank=args.lora_rank)
+                        lora_rank=args.lora_rank,
+                        gate_dtype=("int8"
+                                    if args.quantize == "weights+pages"
+                                    else "bf16"))
     record = stamp_record({
         "metric": "serving_chaos" if args.chaos else "serving",
         "config": args.config,
@@ -668,12 +719,71 @@ def main() -> None:
             "robustness": counters,
         })
 
+    extra_records: list = []
+    if args.quantize:
+        from progen_tpu.decode.paging import RESERVED_PAGES
+
+        qtag = "w8" if args.quantize == "weights" else "w8p8"
+        record["metric"] = f"serving_quant_{qtag}"
+        record["quantize"] = args.quantize
+        record["schedule_hash"] = sched_hash
+        record["quant_decode_tok_s"] = record["tokens_per_sec"]
+        record["weight_hbm_bytes_full"] = plan.weight_bytes_full
+        record["weight_hbm_bytes_int8"] = plan.weight_bytes_int8
+        ppr = -(-max_len // args.page_size)
+        if args.paged:
+            record["gate_dtype"] = engine.gate_dtype
+            # concurrent max_len requests the pool can hold at this byte
+            # budget — the equal-HBM capacity int8 pages are bought for
+            record["equal_hbm_inflight"] = (
+                (engine._pool.num_pages - RESERVED_PAGES) // ppr)
+        # full-precision reference driven on the IDENTICAL schedule (and
+        # when budgeted, the SAME byte budget -> fewer bf16 pages)
+        fp_eng = mk_engine(robust=True, use_quant=False)
+        warm(fp_eng)
+        fp_done, fp_wall, fp_mif = drive(fp_eng)
+        fp_ok = [c for c in fp_done if c.ok]
+        fp_tok = int(sum(len(c.tokens) for c in fp_ok))
+        fp_lat = sorted(c.latency for c in fp_ok) or [0.0]
+        f50, f95 = latency_percentiles(fp_lat, name="bench.fp_latency_s")
+        fp_plan = serving_plan(cfg, num_slots=args.slots, max_len=max_len,
+                               paged=args.paged, page_size=args.page_size,
+                               num_pages=num_pages_fp)
+        fp_record = stamp_record({
+            "metric": f"serving_quant_{qtag}_full",
+            "config": args.config,
+            "requests": args.requests,
+            "schedule_hash": sched_hash,
+            "slots": args.slots,
+            "chunk": args.chunk,
+            "max_new_tokens": args.max_new,
+            "max_len": max_len,
+            "paged": args.paged,
+            "max_in_flight": fp_mif,
+            "gate_hbm_bytes": fp_plan.pageable_bytes,
+            "wall_s": round(fp_wall, 3),
+            "generated_tokens": fp_tok,
+            "tokens_per_sec": round(fp_tok / fp_wall, 1),
+            "p50_latency_s": round(f50, 3),
+            "p95_latency_s": round(f95, 3),
+            "platform": jax.devices()[0].platform,
+        })
+        if args.paged:
+            fp_record["gate_dtype"] = fp_eng.gate_dtype
+            fp_record["num_pages"] = fp_eng._pool.num_pages
+            fp_record["equal_hbm_inflight"] = (
+                (fp_eng._pool.num_pages - RESERVED_PAGES) // ppr)
+        extra_records.append(fp_record)
+
     if args.verify:
         if mix:
             _verify_mix(mk_engine, make_request, done, workloads,
                         scaffolds, args)
         else:
             _verify(mk_engine, make_request, done, args)
+        if args.quantize:
+            record.update(_verify_quant(mk_engine, specs, args, cfg,
+                                        params, policy))
         record["verified"] = True
 
     if args.trace:
@@ -682,11 +792,12 @@ def main() -> None:
         if merged:
             record["trace"] = merged
 
-    line = json.dumps(record)
-    print(line, flush=True)
-    if args.out:
-        with open(args.out, "a") as f:
-            f.write(line + "\n")
+    for rec in [record, *extra_records]:
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(line + "\n")
 
 
 def _load_qos_trace(path: str):
@@ -1524,6 +1635,73 @@ def _verify_mix(mk_engine, make_request, done, workloads, scaffolds,
         "scenario-mix snapshot -> restore -> replay diverged")
     print("verify: scenario-mix rerun identity, constraint enforcement, "
           "tenant-0 identity and snapshot replay OK", file=sys.stderr)
+
+
+def _verify_quant(mk_engine, specs, args, cfg, params, policy) -> dict:
+    """The accuracy tier behind ``--quantize`` (docs/SERVING.md §12):
+    greedy (temperature 0) decode of the fixed schedule on the quantized
+    engine vs the full-precision engine, scored as the fraction of
+    full-precision tokens the quantized stream reproduces before its
+    first divergence (longest-common-prefix, summed over requests).
+    Greedy decode is the right probe — it removes sampling noise, so
+    every mismatch is a real argmax flip.  When a divergence exists the
+    report includes the max logit rtol at the first diverging position
+    (teacher-forced, both precisions on the identical prefix): the
+    honest "how close was the call" number.  Fails the run below
+    ``--match-gate``."""
+    from progen_tpu.decode import Request as Rq
+    from progen_tpu.decode.engine import ServingEngine
+    from progen_tpu.models import ProGen
+
+    def greedy(eng):
+        for uid, toks in enumerate(specs):
+            eng.submit(Rq(uid=uid, tokens=list(toks),
+                          max_new_tokens=args.max_new, top_k=None,
+                          temperature=0.0, seed=args.seed + uid,
+                          submit_time=time.perf_counter()))
+        return {c.uid: c.tokens.tolist() for c in eng.run_until_idle()}
+
+    full = greedy(mk_engine(robust=False, use_quant=False))
+    quant = greedy(mk_engine(robust=False))
+    matched = total = 0
+    first_div = None
+    for uid in sorted(full):
+        f, q = full[uid], quant.get(uid, [])
+        lcp = 0
+        for a, b in zip(f, q):
+            if a != b:
+                break
+            lcp += 1
+        matched += lcp
+        total += len(f)
+        if first_div is None and lcp < min(len(f), len(q)):
+            first_div = (uid, lcp)
+    rate = matched / max(1, total)
+    out = {"token_match_rate": round(rate, 4),
+           "match_gate": args.match_gate,
+           "greedy_tokens_compared": total}
+    if first_div is not None:
+        uid, lcp = first_div
+        prefix = list(specs[uid]) + full[uid][:lcp]
+        toks = jnp.zeros((1, cfg.seq_len), jnp.int32)
+        toks = toks.at[0, :len(prefix)].set(jnp.asarray(prefix))
+        fp_logits = ProGen(config=cfg, policy=policy).apply(
+            params, toks)[0, len(prefix) - 1].astype(jnp.float32)
+        qvars = ServingEngine._quantize_variables(params)
+        q_logits = ProGen(config=cfg, policy=policy,
+                          weights="int8").apply(
+            qvars, toks)[0, len(prefix) - 1].astype(jnp.float32)
+        rtol = jnp.max(jnp.abs(q_logits - fp_logits)
+                       / (jnp.abs(fp_logits) + 1e-6))
+        out["first_divergence_uid"] = uid
+        out["max_logit_rtol_at_divergence"] = round(float(rtol), 5)
+    if rate < args.match_gate:
+        raise SystemExit(
+            f"quant verify: token_match_rate {rate:.4f} < gate "
+            f"{args.match_gate} — quantized serving rejected")
+    print(f"verify: quant greedy token match {rate:.4f} over {total} "
+          f"tokens (gate {args.match_gate}) OK", file=sys.stderr)
+    return out
 
 
 def _verify(mk_engine, make_request, done, args) -> None:

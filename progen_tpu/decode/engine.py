@@ -95,6 +95,7 @@ from progen_tpu.decode.incremental import (
     ProGenPagedDecodeStep,
     init_caches,
     init_gate_pool,
+    init_gate_scale,
 )
 from progen_tpu.decode.paging import (
     DUMP_PAGE,
@@ -321,7 +322,8 @@ class ServingEngine:
                  draft_params=None, spec_k: int = 4,
                  disagg: bool = False, prefill_batch: int | None = None,
                  handoff_depth: int = 2, remote_prefill: bool = False,
-                 lora_bank=None, qos_weights: dict | None = None):
+                 lora_bank=None, qos_weights: dict | None = None,
+                 quantize: str | None = None):
         self.config = config
         self.policy = policy or make_policy()
         self.num_slots = num_slots
@@ -379,6 +381,23 @@ class ServingEngine:
         if params_shardings is not None:
             params = jax.device_put(params, {"params": params_shardings})
 
+        # opt-in quantized serving: "weights" re-types every dense kernel
+        # and SGU spatial weight to int8 (f32 scales in a parallel
+        # "qscale" collection); "weights+pages" additionally stores the
+        # paged SGU gate cache as 8-bit pages.  None (default) is the
+        # unchanged bit-gated full-precision engine.
+        if quantize not in (None, "weights", "weights+pages"):
+            raise ValueError(f"quantize {quantize!r}: want None, "
+                             f"'weights' or 'weights+pages'")
+        if quantize == "weights+pages" and not paged:
+            raise ValueError("quantize='weights+pages' requires paged=True "
+                             "(the 8-bit gate format is a page format)")
+        self.quantize = quantize
+        self._weights_mode = "int8" if quantize else "bf16"
+        self.gate_dtype = "int8" if quantize == "weights+pages" else "bf16"
+        if quantize:
+            params = self._quantize_variables(params)
+
         self.spec = spec
         self.disagg = disagg
         self.lora = lora_bank is not None
@@ -401,6 +420,11 @@ class ServingEngine:
             self.spec_k = int(spec_k)
             self.draft_config = draft_config or config
             check_draft_config(config, self.draft_config)
+            # an identity draft shares the (possibly quantized) target
+            # params, so its models must match the target's weight mode;
+            # an explicit draft stays full precision
+            identity_draft = draft_params is None and draft_config is None
+            draft_weights = self._weights_mode if identity_draft else "bf16"
             if draft_params is None:
                 if draft_config is None:
                     draft_params = params  # identity draft
@@ -417,9 +441,11 @@ class ServingEngine:
             self._spec_rounds = max(1, chunk_size // (self.spec_k + 1))
             self._max_advance = self._spec_rounds * (self.spec_k + 1)
             self._draft_step_model = ProGenDecodeStep(
-                config=self.draft_config, policy=self.policy)
+                config=self.draft_config, policy=self.policy,
+                weights=draft_weights)
             self._draft_prefill_model = ProGen(config=self.draft_config,
-                                               policy=self.policy)
+                                               policy=self.policy,
+                                               weights=draft_weights)
             self._spec_emitted = jnp.zeros((), jnp.int32)
             self._spec_verify_rounds = jnp.zeros((), jnp.int32)
             self._params = {"target": params, "draft": draft_params}
@@ -459,7 +485,8 @@ class ServingEngine:
             if num_pages is None:
                 num_pages = RESERVED_PAGES + num_slots * self.pages_per_row
             self._pool = PagePool(num_pages, page_size,
-                                  prefix_caching=prefix_cache)
+                                  prefix_caching=prefix_cache,
+                                  gate_dtype=self.gate_dtype)
             self._slot_pages: dict[int, SlotPages] = {}
             self._page_table = np.zeros((num_slots, self.pages_per_row),
                                         np.int32)
@@ -471,19 +498,22 @@ class ServingEngine:
             self.prefix_lookups = 0
             self._paged_step_model = ProGenPagedDecodeStep(
                 config=config, n_rows=self.max_len, policy=self.policy,
-                impl=paged_impl)
+                impl=paged_impl, weights=self._weights_mode,
+                gate_dtype=self.gate_dtype)
             self._decode_chunk = jax.jit(
                 self._decode_chunk_spec_paged_impl if spec
                 else self._decode_chunk_paged_impl)
             self._admit = jax.jit(self._admit_paged_impl)
         else:
             self._step_model = ProGenDecodeStep(config=config,
-                                                policy=self.policy)
+                                                policy=self.policy,
+                                                weights=self._weights_mode)
             self._decode_chunk = jax.jit(
                 self._decode_chunk_spec_impl if spec
                 else self._decode_chunk_impl)
             self._admit = jax.jit(self._admit_impl)
-        self._prefill_model = ProGen(config=config, policy=self.policy)
+        self._prefill_model = ProGen(config=config, policy=self.policy,
+                                     weights=self._weights_mode)
         if remote_prefill and not disagg:
             raise ValueError("remote_prefill requires disagg=True")
         self.remote_prefill = remote_prefill
@@ -503,7 +533,8 @@ class ServingEngine:
         self._embed_queue: deque[Request] = deque()
         self.embed_batch = num_slots
         self._embedder = make_embedder(config, self.policy, mesh=mesh,
-                                       strategies=self.strategies)
+                                       strategies=self.strategies,
+                                       weights=self._weights_mode)
         self.state = self._init_state()
 
     # ---------------------------------------------------------------- state
@@ -517,7 +548,10 @@ class ServingEngine:
                 caches.pop("sgu_gate")
                 caches["sgu_pool"] = init_gate_pool(
                     self.config, self._pool.num_pages, self.page_size,
-                    self.policy)
+                    self.policy, gate_dtype=self.gate_dtype)
+                if self.gate_dtype == "int8":
+                    caches["sgu_pool_scale"] = init_gate_scale(
+                        self.config, self._pool.num_pages, self.page_size)
             if self.mesh is not None:
                 caches = _constrain_caches(caches, self.mesh, self.strategies)
         keys = jax.vmap(jax.random.key)(jnp.zeros((s,), jnp.uint32))
@@ -642,6 +676,19 @@ class ServingEngine:
         model applies no delta and traces exactly as before)."""
         return params["adapters"] if self.lora else None
 
+    @staticmethod
+    def _quantize_variables(variables):
+        """Re-type a full-precision variables dict for int8 serving:
+        dense kernels and SGU spatial weights become int8 leaves (same
+        tree structure, so shardings and AOT shapes carry over) and the
+        per-channel f32 scales ride in a parallel ``qscale`` collection.
+        LoRA adapter banks are NOT quantized — deltas stay full precision
+        on top of the int8 base."""
+        from progen_tpu.ops.quant import quantize_params
+
+        qtree, scales = quantize_params(variables["params"])
+        return {**variables, "params": qtree, "qscale": scales}
+
     def _activate_xla_fallback(self) -> None:
         """Degrade the paged decode step from the Pallas ragged kernel to
         its bit-identical XLA gather fallback (``ops/
@@ -653,7 +700,8 @@ class ServingEngine:
         self.paged_impl = "xla"
         self._paged_step_model = ProGenPagedDecodeStep(
             config=self.config, n_rows=self.max_len, policy=self.policy,
-            impl="xla")
+            impl="xla", weights=self._weights_mode,
+            gate_dtype=self.gate_dtype)
         self._decode_chunk = jax.jit(
             self._decode_chunk_spec_paged_impl if self.spec
             else self._decode_chunk_paged_impl)
@@ -815,6 +863,10 @@ class ServingEngine:
                     **{k: jax.tree.map(mrg, caches[k], st["caches"][k])
                        for k in self._RING_KEYS},
                     "sgu_pool": caches["sgu_pool"],
+                    # 8-bit gate pages carry a per-row scale pool whose
+                    # writes are masked inside the step, like the pool's
+                    **({"sgu_pool_scale": caches["sgu_pool_scale"]}
+                       if "sgu_pool_scale" in caches else {}),
                 }
                 kd, sub = split_keys_batched(st["keys"])
                 writepos = jnp.clip(pos + 1, 0, self.max_len - 1)
@@ -856,9 +908,15 @@ class ServingEngine:
             caches_new = harvest_caches(cfg, varz["cache"], lengths,
                                         self.policy, self.max_len,
                                         with_sgu=False)
-            pool_new = harvest_gate_pages(
-                cfg, varz["cache"], lengths,
-                state["caches"]["sgu_pool"], wtable, self.policy)
+            if self.gate_dtype == "int8":
+                pool_new, pscale_new = harvest_gate_pages(
+                    cfg, varz["cache"], lengths,
+                    state["caches"]["sgu_pool"], wtable, self.policy,
+                    pool_scale=state["caches"]["sgu_pool_scale"])
+            else:
+                pool_new = harvest_gate_pages(
+                    cfg, varz["cache"], lengths,
+                    state["caches"]["sgu_pool"], wtable, self.policy)
             if self.mesh is not None:
                 caches_new = _constrain_caches(caches_new, self.mesh,
                                                self.strategies)
@@ -899,6 +957,8 @@ class ServingEngine:
             **{k: jax.tree.map(merge, caches_new[k], state["caches"][k])
                for k in self._RING_KEYS},
             "sgu_pool": pool_new,
+            **({"sgu_pool_scale": pscale_new}
+               if self.gate_dtype == "int8" else {}),
         }
         out = {
             "seq": merge(seq, state["seq"]),
@@ -987,6 +1047,8 @@ class ServingEngine:
                     **{k: jax.tree.map(mrg, new[k], old[k])
                        for k in self._RING_KEYS},
                     "sgu_pool": new["sgu_pool"],
+                    **({"sgu_pool_scale": new["sgu_pool_scale"]}
+                       if "sgu_pool_scale" in new else {}),
                 }
 
             emitted = jnp.zeros((), jnp.int32)
@@ -1089,13 +1151,23 @@ class ServingEngine:
         if self.paged:
             (row_wtable,) = extra
             h_caches = hstate["caches"]
-            pool = scatter_gate_rows(
-                self.config, gate_rows, hstate["start"],
-                state["caches"]["sgu_pool"], row_wtable)
+            if self.gate_dtype == "int8":
+                # handle slabs arrive in compute dtype; they quantize
+                # here, at the page-pool boundary
+                pool, pscale = scatter_gate_rows(
+                    self.config, gate_rows, hstate["start"],
+                    state["caches"]["sgu_pool"], row_wtable,
+                    pool_scale=state["caches"]["sgu_pool_scale"])
+            else:
+                pool = scatter_gate_rows(
+                    self.config, gate_rows, hstate["start"],
+                    state["caches"]["sgu_pool"], row_wtable)
             caches = {
                 **{k: jax.tree.map(take, h_caches[k], state["caches"][k])
                    for k in self._RING_KEYS},
                 "sgu_pool": pool,
+                **({"sgu_pool_scale": pscale}
+                   if self.gate_dtype == "int8" else {}),
             }
         else:
             caches = jax.tree.map(take, hstate["caches"],
@@ -2431,6 +2503,11 @@ class ServingEngine:
         if lora_bank is not None and not self.lora:
             raise ValueError("engine was built without a LoRA bank; the "
                              "bank's shape is baked into its programs")
+        if params is not None and self.quantize:
+            # the serving tree is int8 + qscale; incoming checkpoints
+            # arrive full precision and re-quantize at the door
+            params = self._quantize_variables(
+                jax.tree.map(jnp.asarray, params))
 
         def _swap(new, old, what):
             new = jax.tree.map(jnp.asarray, new)

@@ -109,18 +109,43 @@ def init_caches(config: ProGenConfig, batch_size: int,
 
 
 def init_gate_pool(config: ProGenConfig, num_pages: int, page_size: int,
-                   policy: Policy | None = None) -> dict:
+                   policy: Policy | None = None,
+                   gate_dtype: str = "bf16") -> dict:
     """Zero global gate-row pool, one ``(num_pages, page_size, hidden/2)``
     array per gMLP layer (keyed like ``sgu_gate``).  Page 0 is the
     all-zeros NULL page and stays zero forever (reads of unowned table
     entries land here and match the dense engine's zero-initialized
-    cache); page 1 is the write-sink DUMP page."""
+    cache); page 1 is the write-sink DUMP page.
+
+    ``gate_dtype="int8"`` allocates the pool in int8 (the 8-bit page
+    format); rows are quantized per-row on scatter against the parallel
+    f32 scale pool from :func:`init_gate_scale`.  NULL-page reads stay
+    exact zeros (0 * scale == 0.0)."""
     c = config
     pol = policy or make_policy()
-    dt = pol.compute_dtype
+    if gate_dtype == "int8":
+        dt = jnp.int8
+    elif gate_dtype == "bf16":
+        dt = pol.compute_dtype
+    else:
+        raise ValueError(f"unknown gate_dtype {gate_dtype!r}; "
+                         "use 'bf16' or 'int8'")
     half = (c.dim * c.ff_mult) // 2
     return {
         str(i): jnp.zeros((num_pages, page_size, half), dt)
+        for i in range(c.depth) if c.layer_uses_gmlp(i)
+    }
+
+
+def init_gate_scale(config: ProGenConfig, num_pages: int,
+                    page_size: int) -> dict:
+    """Per-row f32 scale pool for the int8 gate pages: one
+    ``(num_pages, page_size)`` array per gMLP layer, mirroring
+    :func:`init_gate_pool`'s page layout.  Ones-initialized so a
+    never-written row dequantizes to exact zeros."""
+    c = config
+    return {
+        str(i): jnp.ones((num_pages, page_size), jnp.float32)
         for i in range(c.depth) if c.layer_uses_gmlp(i)
     }
 
@@ -134,6 +159,7 @@ class LocalAttentionDecode(nn.Module):
     dim_head: int
     shift: bool
     policy: Policy
+    weights: str = "bf16"
 
     @nn.compact
     def __call__(self, x, sin_row, cos_row, slot, valid, prev, k_cache, v_cache,
@@ -148,7 +174,8 @@ class LocalAttentionDecode(nn.Module):
             normed = _shift_with_carry(normed, prev)
 
         qkv = _dense(inner * 3, use_bias=False, axes=("embed", "qkv"),
-                     policy=self.policy, name="to_qkv")(normed)
+                     policy=self.policy, name="to_qkv",
+                     weights=self.weights)(normed)
         if adapters is not None:
             qkv = apply_lora(qkv, normed, adapters["qkv"], tenant)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -169,7 +196,8 @@ class LocalAttentionDecode(nn.Module):
             preferred_element_type=jnp.float32,
         ).astype(v_cache.dtype).reshape(b, inner)
         proj = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
-                      policy=self.policy, name="to_out")(out)
+                      policy=self.policy, name="to_out",
+                      weights=self.weights)(out)
         if adapters is not None:
             proj = apply_lora(proj, out, adapters["out"], tenant)
         return proj, new_prev, k_cache, v_cache
@@ -182,6 +210,7 @@ class SGUDecode(nn.Module):
     dim_out: int
     policy: Policy
     eps: float = 1e-3
+    weights: str = "bf16"
 
     @nn.compact
     def __call__(self, x, pos, gate_cache, adapters=None, tenant=None):
@@ -195,8 +224,16 @@ class SGUDecode(nn.Module):
             return jax.random.uniform(key, shape, dtype,
                                       minval=-init_scale, maxval=init_scale)
 
-        weights = self.param("spatial_weights", symmetric_uniform, (n, n),
-                             self.policy.param_dtype)
+        if self.weights == "int8":
+            weights = self.param("spatial_weights", nn.initializers.zeros,
+                                 (n, n), jnp.int8)
+            w_scale = self.variable(
+                "qscale", "spatial_weights_scale",
+                lambda: jnp.ones((n,), jnp.float32)).value
+        else:
+            weights = self.param("spatial_weights", symmetric_uniform, (n, n),
+                                 self.policy.param_dtype)
+            w_scale = None
         biases = self.param("spatial_biases", nn.initializers.ones, (n, 1),
                             self.policy.param_dtype)
 
@@ -207,6 +244,9 @@ class SGUDecode(nn.Module):
         n_cache = gate_cache.shape[1]
         gate_cache = _update_rows(gate_cache, gate, pos, axis=0)
         w_rows = weights.astype(jnp.float32)[pos][:, :n_cache]  # (B, n_cache)
+        if w_scale is not None:
+            # per-ROW scale: each batch row reads weight row pos[b]
+            w_rows = w_rows * w_scale[pos][:, None]
         causal = (jnp.arange(n_cache)[None, :] <= pos[:, None])
         w_rows = w_rows * causal.astype(jnp.float32)
         mixed = jnp.einsum("bnd,bn->bd", gate_cache.astype(jnp.float32),
@@ -216,7 +256,8 @@ class SGUDecode(nn.Module):
 
         x = x * mixed
         out = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
-                     policy=self.policy, name="proj_out")(x)
+                     policy=self.policy, name="proj_out",
+                     weights=self.weights)(x)
         if adapters is not None:
             out = apply_lora(out, x, adapters, tenant)
         return out, gate_cache
@@ -230,6 +271,7 @@ class FeedForwardDecode(nn.Module):
     use_sgu: bool
     shift: bool
     policy: Policy
+    weights: str = "bf16"
 
     @nn.compact
     def __call__(self, x, pos, prev, gate_cache, adapters=None, tenant=None):
@@ -241,7 +283,8 @@ class FeedForwardDecode(nn.Module):
             normed = _shift_with_carry(normed, prev)
 
         h = _dense(hidden, use_bias=True, axes=("embed", "mlp"),
-                   policy=self.policy, name="proj_in")(normed)
+                   policy=self.policy, name="proj_in",
+                   weights=self.weights)(normed)
         if self.glu:
             h, gate = jnp.split(h, 2, axis=-1)
             h = h * nn.gelu(gate)
@@ -251,12 +294,13 @@ class FeedForwardDecode(nn.Module):
         if self.use_sgu:
             h, gate_cache = SGUDecode(
                 seq_len=self.seq_len, dim_out=hidden // 2,
-                policy=self.policy, name="sgu",
+                policy=self.policy, weights=self.weights, name="sgu",
             )(h, pos, gate_cache,
               None if adapters is None else adapters["sgu"], tenant)
 
         out = _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
-                     policy=self.policy, name="proj_out")(h)
+                     policy=self.policy, name="proj_out",
+                     weights=self.weights)(h)
         return out, new_prev, gate_cache
 
 
@@ -271,6 +315,7 @@ class ProGenDecodeStep(nn.Module):
 
     config: ProGenConfig
     policy: Policy = dataclasses.field(default_factory=make_policy)
+    weights: str = "bf16"
 
     @nn.compact
     def __call__(self, tok, pos, caches, adapters=None, tenant=None):
@@ -319,7 +364,7 @@ class ProGenDecodeStep(nn.Module):
                 LocalAttentionDecode(
                     dim=cfg.dim, window_size=wsz, heads=cfg.heads,
                     dim_head=cfg.dim_head, shift=cfg.shift_tokens,
-                    policy=pol, name=f"attn{i}",
+                    policy=pol, weights=self.weights, name=f"attn{i}",
                 )(x, sin_row, cos_row, slot, valid,
                   caches["attn_prev"][i], caches["k"][i], caches["v"][i],
                   attn_ad, tenant)
@@ -330,7 +375,8 @@ class ProGenDecodeStep(nn.Module):
             ff_out, new["ff_prev"][i], gate_cache = FeedForwardDecode(
                 dim=cfg.dim, seq_len=cfg.seq_len, ff_mult=cfg.ff_mult,
                 glu=(not use_gmlp) and cfg.ff_glu, use_sgu=use_gmlp,
-                shift=cfg.shift_tokens, policy=pol, name=f"ff{i}",
+                shift=cfg.shift_tokens, policy=pol, weights=self.weights,
+                name=f"ff{i}",
             )(x, pos, caches["ff_prev"][i],
               gate_cache if gate_cache is not None else jnp.zeros(()),
               ff_ad, tenant)
@@ -362,10 +408,12 @@ class SGUDecodePaged(nn.Module):
     policy: Policy
     impl: str = "xla"
     eps: float = 1e-3
+    weights: str = "bf16"
+    gate_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, pos, pool, table, write_ok, adapters=None,
-                 tenant=None):
+    def __call__(self, x, pos, pool, table, write_ok, pool_scale=None,
+                 adapters=None, tenant=None):
         from progen_tpu.ops.pallas_paged_attention import (
             paged_gate_mix, write_gate_row)
 
@@ -379,22 +427,38 @@ class SGUDecodePaged(nn.Module):
             return jax.random.uniform(key, shape, dtype,
                                       minval=-init_scale, maxval=init_scale)
 
-        weights = self.param("spatial_weights", symmetric_uniform, (n, n),
-                             self.policy.param_dtype)
+        if self.weights == "int8":
+            weights = self.param("spatial_weights", nn.initializers.zeros,
+                                 (n, n), jnp.int8)
+            w_scale = self.variable(
+                "qscale", "spatial_weights_scale",
+                lambda: jnp.ones((n,), jnp.float32)).value
+        else:
+            weights = self.param("spatial_weights", symmetric_uniform, (n, n),
+                                 self.policy.param_dtype)
+            w_scale = None
         biases = self.param("spatial_biases", nn.initializers.ones, (n, 1),
                             self.policy.param_dtype)
 
-        pool = write_gate_row(pool, table, pos, gate, write_ok)
+        if self.gate_dtype == "int8":
+            # quantize-on-scatter: the row's int8 code and its f32 scale
+            # land in twin pools through the same dump-redirected target
+            pool, pool_scale = write_gate_row(pool, table, pos, gate,
+                                              write_ok, scale=pool_scale)
+        else:
+            pool = write_gate_row(pool, table, pos, gate, write_ok)
         mixed = paged_gate_mix(weights, biases, pool, table, pos,
-                               n_rows=self.n_rows, impl=self.impl)
+                               n_rows=self.n_rows, impl=self.impl,
+                               w_scale=w_scale, pool_scale=pool_scale)
         mixed = mixed.astype(x.dtype)
 
         x = x * mixed
         out = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
-                     policy=self.policy, name="proj_out")(x)
+                     policy=self.policy, name="proj_out",
+                     weights=self.weights)(x)
         if adapters is not None:
             out = apply_lora(out, x, adapters, tenant)
-        return out, pool
+        return out, pool, pool_scale
 
 
 class FeedForwardDecodePaged(nn.Module):
@@ -408,10 +472,12 @@ class FeedForwardDecodePaged(nn.Module):
     shift: bool
     policy: Policy
     impl: str = "xla"
+    weights: str = "bf16"
+    gate_dtype: str = "bf16"
 
     @nn.compact
-    def __call__(self, x, pos, prev, pool, table, write_ok, adapters=None,
-                 tenant=None):
+    def __call__(self, x, pos, prev, pool, table, write_ok, pool_scale=None,
+                 adapters=None, tenant=None):
         hidden = self.dim * self.ff_mult
 
         normed = _norm(self.policy, name="norm")(x)
@@ -420,18 +486,21 @@ class FeedForwardDecodePaged(nn.Module):
             normed = _shift_with_carry(normed, prev)
 
         h = _dense(hidden, use_bias=True, axes=("embed", "mlp"),
-                   policy=self.policy, name="proj_in")(normed)
+                   policy=self.policy, name="proj_in",
+                   weights=self.weights)(normed)
         h = nn.gelu(h)
 
-        h, pool = SGUDecodePaged(
+        h, pool, pool_scale = SGUDecodePaged(
             seq_len=self.seq_len, dim_out=hidden // 2, n_rows=self.n_rows,
-            policy=self.policy, impl=self.impl, name="sgu",
-        )(h, pos, pool, table, write_ok,
+            policy=self.policy, impl=self.impl, weights=self.weights,
+            gate_dtype=self.gate_dtype, name="sgu",
+        )(h, pos, pool, table, write_ok, pool_scale,
           None if adapters is None else adapters["sgu"], tenant)
 
         out = _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
-                     policy=self.policy, name="proj_out")(h)
-        return out, new_prev, pool
+                     policy=self.policy, name="proj_out",
+                     weights=self.weights)(h)
+        return out, new_prev, pool, pool_scale
 
 
 class ProGenPagedDecodeStep(nn.Module):
@@ -450,6 +519,8 @@ class ProGenPagedDecodeStep(nn.Module):
     n_rows: int
     policy: Policy = dataclasses.field(default_factory=make_policy)
     impl: str = "xla"
+    weights: str = "bf16"
+    gate_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, tok, pos, caches, table, write_ok, adapters=None,
@@ -485,6 +556,8 @@ class ProGenPagedDecodeStep(nn.Module):
             "v": list(caches["v"]),
             "sgu_pool": dict(caches["sgu_pool"]),
         }
+        if self.gate_dtype == "int8":
+            new["sgu_pool_scale"] = dict(caches["sgu_pool_scale"])
 
         for i in range(cfg.depth):
             use_gmlp = cfg.layer_uses_gmlp(i)
@@ -494,7 +567,7 @@ class ProGenPagedDecodeStep(nn.Module):
                 LocalAttentionDecode(
                     dim=cfg.dim, window_size=wsz, heads=cfg.heads,
                     dim_head=cfg.dim_head, shift=cfg.shift_tokens,
-                    policy=pol, name=f"attn{i}",
+                    policy=pol, weights=self.weights, name=f"attn{i}",
                 )(x, sin_row, cos_row, slot, valid,
                   caches["attn_prev"][i], caches["k"][i], caches["v"][i],
                   attn_ad, tenant)
@@ -502,20 +575,27 @@ class ProGenPagedDecodeStep(nn.Module):
             x = x + attn_out
 
             if use_gmlp:
-                ff_out, new["ff_prev"][i], new["sgu_pool"][str(i)] = (
+                pool_scale = (caches["sgu_pool_scale"][str(i)]
+                              if self.gate_dtype == "int8" else None)
+                ff_out, new["ff_prev"][i], new_pool, new_scale = (
                     FeedForwardDecodePaged(
                         dim=cfg.dim, seq_len=cfg.seq_len, ff_mult=cfg.ff_mult,
                         n_rows=self.n_rows, shift=cfg.shift_tokens,
-                        policy=pol, impl=self.impl, name=f"ff{i}",
+                        policy=pol, impl=self.impl, weights=self.weights,
+                        gate_dtype=self.gate_dtype, name=f"ff{i}",
                     )(x, pos, caches["ff_prev"][i],
                       caches["sgu_pool"][str(i)], table, write_ok,
-                      ff_ad, tenant)
+                      pool_scale, ff_ad, tenant)
                 )
+                new["sgu_pool"][str(i)] = new_pool
+                if self.gate_dtype == "int8":
+                    new["sgu_pool_scale"][str(i)] = new_scale
             else:
                 ff_out, new["ff_prev"][i], _ = FeedForwardDecode(
                     dim=cfg.dim, seq_len=cfg.seq_len, ff_mult=cfg.ff_mult,
                     glu=cfg.ff_glu, use_sgu=False,
-                    shift=cfg.shift_tokens, policy=pol, name=f"ff{i}",
+                    shift=cfg.shift_tokens, policy=pol, weights=self.weights,
+                    name=f"ff{i}",
                 )(x, pos, caches["ff_prev"][i], jnp.zeros(()), ff_ad, tenant)
             x = x + ff_out
 

@@ -99,16 +99,23 @@ class PagePool:
     """
 
     def __init__(self, num_pages: int, page_size: int, *,
-                 prefix_caching: bool = True):
+                 prefix_caching: bool = True, gate_dtype: str = "bf16"):
         if num_pages < RESERVED_PAGES + 1:
             raise ValueError(
                 f"num_pages {num_pages} leaves no allocatable pages "
                 f"({RESERVED_PAGES} are reserved)")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if gate_dtype not in ("bf16", "int8"):
+            raise ValueError(f"gate_dtype {gate_dtype!r}: want 'bf16' "
+                             "or 'int8'")
         self.num_pages = num_pages
         self.page_size = page_size
         self.prefix_caching = prefix_caching
+        # bookkeeping only — the device pools live in engine state; the
+        # pool records the page format so stats/capacity reports can say
+        # what a page costs (int8 rows are ~2x denser than bf16)
+        self.gate_dtype = gate_dtype
         # LIFO free list: recently-freed pages are reused first, which
         # keeps the working set dense and makes tests deterministic
         self._free: list[int] = list(range(num_pages - 1,
@@ -244,7 +251,7 @@ class PagePool:
             "capacity": self.capacity,
         }
 
-    def stats(self) -> dict[str, int]:
+    def stats(self) -> dict:
         """Host-side accounting snapshot (robustness/chaos records)."""
         return {
             "num_pages": self.num_pages,
@@ -253,4 +260,5 @@ class PagePool:
             "cached_pages": self.cached_pages,
             "shared_pages": self.shared_pages,
             "pages_in_use": self.capacity - self.free_pages,
+            "gate_dtype": self.gate_dtype,
         }

@@ -178,7 +178,7 @@ def harvest_caches(config: ProGenConfig, sown: dict, lengths, policy: Policy,
 
 
 def harvest_gate_pages(config: ProGenConfig, sown: dict, lengths, pool: dict,
-                       wtable, policy: Policy) -> dict:
+                       wtable, policy: Policy, pool_scale: dict | None = None):
     """Scatter the prefill's sown gate rows straight into the page pool.
 
     The paged engine's admission path: instead of building a contiguous
@@ -189,11 +189,17 @@ def harvest_gate_pages(config: ProGenConfig, sown: dict, lengths, pool: dict,
     pages it must not write — prefix-cache hits (read-only, filled by the
     first request that computed them) and unowned tail entries.  Pad rows
     (``i >= lengths[b]``) are dumped too, so the scatter stays dense.
+
+    With ``pool_scale`` (the f32 twin of an int8 pool, see
+    ``init_gate_scale``) every gate row is quantized per-row before the
+    scatter and the call returns ``(new_pool, new_scale)``.
     """
     from progen_tpu.decode.paging import DUMP_PAGE
+    from progen_tpu.ops.quant import quantize_rows
 
     c = config
     new_pool = dict(pool)
+    new_scale = dict(pool_scale) if pool_scale is not None else None
     for i in range(c.depth):
         if not c.layer_uses_gmlp(i):
             continue
@@ -209,14 +215,25 @@ def harvest_gate_pages(config: ProGenConfig, sown: dict, lengths, pool: dict,
         tgt = wtable[:, page_idx]  # (B, P_pad)
         tgt = jnp.where(rows[None, :] < lengths[:, None], tgt, DUMP_PAGE)
         off = jnp.broadcast_to((rows % page_size)[None, :], (b, p_pad))
-        new_pool[str(i)] = layer_pool.at[
-            tgt.reshape(-1), off.reshape(-1)
-        ].set(gate.astype(layer_pool.dtype).reshape(-1, half))
+        if new_scale is None:
+            new_pool[str(i)] = layer_pool.at[
+                tgt.reshape(-1), off.reshape(-1)
+            ].set(gate.astype(layer_pool.dtype).reshape(-1, half))
+        else:
+            q, s = quantize_rows(gate)  # (B, P_pad, half) int8, (B, P_pad)
+            new_pool[str(i)] = layer_pool.at[
+                tgt.reshape(-1), off.reshape(-1)
+            ].set(q.reshape(-1, half))
+            new_scale[str(i)] = pool_scale[str(i)].at[
+                tgt.reshape(-1), off.reshape(-1)
+            ].set(s.reshape(-1))
+    if new_scale is not None:
+        return new_pool, new_scale
     return new_pool
 
 
 def scatter_gate_rows(config: ProGenConfig, gate_rows: dict, lengths,
-                      pool: dict, wtable) -> dict:
+                      pool: dict, wtable, pool_scale: dict | None = None):
     """Scatter DENSE per-row gate slabs into the page pool.
 
     The disaggregated admission path (``decode/handoff.py``): the
@@ -228,10 +245,17 @@ def scatter_gate_rows(config: ProGenConfig, gate_rows: dict, lengths,
     prefill intermediates) as the source.  ``wtable`` rows for prefix-
     shared pages, unadmitted handle rows and pad tails hold
     ``DUMP_PAGE``.
+
+    Handle slabs ride the handoff in the COMPUTE dtype regardless of the
+    pool's format (the prefill worker cannot know the decode pool's page
+    layout); with ``pool_scale`` the rows are quantized here, at the
+    merge, and the call returns ``(new_pool, new_scale)``.
     """
     from progen_tpu.decode.paging import DUMP_PAGE
+    from progen_tpu.ops.quant import quantize_rows
 
     new_pool = dict(pool)
+    new_scale = dict(pool_scale) if pool_scale is not None else None
     for i in range(config.depth):
         if not config.layer_uses_gmlp(i):
             continue
@@ -245,15 +269,27 @@ def scatter_gate_rows(config: ProGenConfig, gate_rows: dict, lengths,
         tgt = wtable[:, page_idx]  # (B, n_rows)
         tgt = jnp.where(rows[None, :] < lengths[:, None], tgt, DUMP_PAGE)
         off = jnp.broadcast_to((rows % page_size)[None, :], (b, n_rows))
-        new_pool[str(i)] = layer_pool.at[
-            tgt.reshape(-1), off.reshape(-1)
-        ].set(gate.astype(layer_pool.dtype).reshape(-1, half))
+        if new_scale is None:
+            new_pool[str(i)] = layer_pool.at[
+                tgt.reshape(-1), off.reshape(-1)
+            ].set(gate.astype(layer_pool.dtype).reshape(-1, half))
+        else:
+            q, s = quantize_rows(gate)
+            new_pool[str(i)] = layer_pool.at[
+                tgt.reshape(-1), off.reshape(-1)
+            ].set(q.reshape(-1, half))
+            new_scale[str(i)] = pool_scale[str(i)].at[
+                tgt.reshape(-1), off.reshape(-1)
+            ].set(s.reshape(-1))
+    if new_scale is not None:
+        return new_pool, new_scale
     return new_pool
 
 
 def make_embedder(config: ProGenConfig, policy: Policy | None = None,
                   mesh: Mesh | None = None,
-                  strategies: Sequence[str] = ("dp",)):
+                  strategies: Sequence[str] = ("dp",),
+                  weights: str = "bf16"):
     """Build ``embed(params, tokens, lengths) -> (B, dim) f32``: the
     embeddings-endpoint program.
 
@@ -268,7 +304,7 @@ def make_embedder(config: ProGenConfig, policy: Policy | None = None,
     """
     policy = policy or make_policy()
     model = ProGen(config=config, policy=policy, mesh=None,
-                   sow_final_hidden=True)
+                   sow_final_hidden=True, weights=weights)
 
     if mesh is not None:
         from progen_tpu.parallel.sharding import logical_rules
@@ -307,7 +343,8 @@ def make_embedder(config: ProGenConfig, policy: Policy | None = None,
 
 def make_prefiller(config: ProGenConfig, policy: Policy | None = None,
                    mesh: Mesh | None = None,
-                   strategies: Sequence[str] = ("dp",)):
+                   strategies: Sequence[str] = ("dp",),
+                   weights: str = "bf16"):
     """Build ``prefill(params, tokens, lengths, decode_len)``.
 
     ``tokens``: ``(B, P_pad)`` int prime tokens, right-padded; ``P_pad``
@@ -322,7 +359,7 @@ def make_prefiller(config: ProGenConfig, policy: Policy | None = None,
     decode caches identical to sequentially teacher-forcing the prime.
     """
     policy = policy or make_policy()
-    model = ProGen(config=config, policy=policy, mesh=None)
+    model = ProGen(config=config, policy=policy, mesh=None, weights=weights)
 
     if mesh is not None:
         from progen_tpu.parallel.sharding import logical_rules

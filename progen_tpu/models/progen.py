@@ -39,6 +39,7 @@ from jax.sharding import Mesh
 
 from progen_tpu.core.precision import Policy, make_policy
 from progen_tpu.ops.local_attention import local_attention
+from progen_tpu.ops.quant import QuantDense
 from progen_tpu.ops.rotary import apply_rotary_pos_emb, fixed_pos_embedding
 from progen_tpu.ops.sgu import spatial_gate
 from progen_tpu.ops.shift import shift_tokens
@@ -124,7 +125,18 @@ def _norm(policy: Policy, name: str | None = None) -> nn.LayerNorm:
 
 
 def _dense(features: int, *, use_bias: bool, axes: tuple[str, str],
-           policy: Policy, name: str | None = None) -> nn.Dense:
+           policy: Policy, name: str | None = None,
+           weights: str = "bf16") -> nn.Module:
+    # weights="int8": the serving-only quantized path — an int8 kernel
+    # with the SAME param names ("kernel"/"bias") and its f32 scale in a
+    # parallel "qscale" collection (ops/quant.py).  "bf16" (the default)
+    # is the unchanged full-precision layer.
+    if weights == "int8":
+        return QuantDense(features, use_bias=use_bias, axes=axes,
+                          policy=policy, name=name)
+    if weights != "bf16":
+        raise ValueError(f"unknown weights mode {weights!r}; "
+                         "use 'bf16' or 'int8'")
     bias_axes = (axes[-1],)
     return nn.Dense(
         features,
@@ -155,6 +167,7 @@ class LocalAttention(nn.Module):
     attn_impl: str = "xla"  # "xla" | "pallas"
     mesh: Mesh | None = None  # seq axis >1 -> context-parallel halo path
     sow_caches: bool = True  # False: skip decode-carry sows (embeddings path)
+    weights: str = "bf16"  # "int8": quantized projections (ops/quant.py)
 
     @nn.compact
     def __call__(self, x, sin, cos, adapters=None, tenant=None):
@@ -173,7 +186,8 @@ class LocalAttention(nn.Module):
             x = shift_tokens(x)
 
         qkv = _dense(inner * 3, use_bias=False, axes=("embed", "qkv"),
-                     policy=self.policy, name="to_qkv")(x)
+                     policy=self.policy, name="to_qkv",
+                     weights=self.weights)(x)
         if adapters is not None:
             qkv = apply_lora(qkv, x, adapters["qkv"], tenant)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -230,7 +244,8 @@ class LocalAttention(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(b, n, inner)
         out = checkpoint_name(out, "attn_out")
         y = _dense(self.dim, use_bias=True, axes=("qkv", "embed"),
-                   policy=self.policy, name="to_out")(out)
+                   policy=self.policy, name="to_out",
+                   weights=self.weights)(out)
         if adapters is not None:
             y = apply_lora(y, out, adapters["out"], tenant)
         return y
@@ -251,6 +266,7 @@ class SGU(nn.Module):
     sgu_impl: str = "xla"  # "xla" | "pallas" (blocked-causal fused kernel)
     mesh: Mesh | None = None  # seq axis >1 -> sharded spatial matmul
     sow_caches: bool = True
+    weights: str = "bf16"  # "int8": quantized spatial weights + proj_out
 
     @nn.compact
     def __call__(self, x, adapters=None, tenant=None):
@@ -269,14 +285,32 @@ class SGU(nn.Module):
                 key, shape, dtype, minval=-init_scale, maxval=init_scale
             )
 
-        weights = self.param(
-            "spatial_weights",
-            nn.with_logical_partitioning(
-                symmetric_uniform, ("spatial_row", "spatial_col")
-            ),
-            (n, n),
-            self.policy.param_dtype,
-        )
+        if self.weights == "int8":
+            # int8 per-row spatial weights: same leaf name, re-typed; the
+            # f32 row scale rides in "qscale" and is folded back here in
+            # f32 (the mix contracts over COLUMNS, so one scale per row
+            # is exact up to quantization rounding)
+            weights_q = self.param(
+                "spatial_weights",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("spatial_row", "spatial_col")
+                ),
+                (n, n),
+                jnp.int8,
+            )
+            w_scale = self.variable(
+                "qscale", "spatial_weights_scale",
+                lambda: jnp.ones((n,), jnp.float32)).value
+            weights = weights_q.astype(jnp.float32) * w_scale[:, None]
+        else:
+            weights = self.param(
+                "spatial_weights",
+                nn.with_logical_partitioning(
+                    symmetric_uniform, ("spatial_row", "spatial_col")
+                ),
+                (n, n),
+                self.policy.param_dtype,
+            )
         biases = self.param(
             "spatial_biases",
             nn.with_logical_partitioning(nn.initializers.ones, ("spatial_row", None)),
@@ -335,7 +369,8 @@ class SGU(nn.Module):
                 gate = spatial_gate(gate, w, b)
                 x = x * gate
         y = _dense(self.dim_out, use_bias=True, axes=("mlp_in", "mlp"),
-                   policy=self.policy, name="proj_out")(x)
+                   policy=self.policy, name="proj_out",
+                   weights=self.weights)(x)
         if adapters is not None:
             y = apply_lora(y, x, adapters, tenant)
         return y
@@ -358,6 +393,7 @@ class FeedForward(nn.Module):
     sgu_impl: str = "xla"
     mesh: Mesh | None = None
     sow_caches: bool = True
+    weights: str = "bf16"  # "int8": quantized channel projections
 
     @nn.compact
     def __call__(self, x, adapters=None, tenant=None):
@@ -371,7 +407,8 @@ class FeedForward(nn.Module):
             x = shift_tokens(x)
 
         x = _dense(hidden, use_bias=True, axes=("embed", "mlp"),
-                   policy=self.policy, name="proj_in")(x)
+                   policy=self.policy, name="proj_in",
+                   weights=self.weights)(x)
         x = nn.with_logical_constraint(x, ("act_batch", "act_seq", "act_mlp"))
 
         if self.glu:
@@ -384,13 +421,14 @@ class FeedForward(nn.Module):
             x = SGU(seq_len=self.seq_len, dim_out=hidden // 2,
                     policy=self.policy, sgu_impl=self.sgu_impl,
                     mesh=self.mesh, sow_caches=self.sow_caches,
-                    name="sgu")(
+                    weights=self.weights, name="sgu")(
                         x,
                         None if adapters is None else adapters["sgu"],
                         tenant)
 
         return _dense(self.dim, use_bias=True, axes=("mlp", "embed"),
-                      policy=self.policy, name="proj_out")(x)
+                      policy=self.policy, name="proj_out",
+                      weights=self.weights)(x)
 
 
 class ProGen(nn.Module):
@@ -432,6 +470,11 @@ class ProGen(nn.Module):
     # tensor instead of full decode caches.  False (the default) is
     # byte-identical to the pre-switch model for all existing callers.
     sow_final_hidden: bool = False
+    # "int8": serve quantized weights (ops/quant.py) — every block dense
+    # and the SGU spatial weights re-typed int8 with f32 scales in the
+    # "qscale" collection.  Embedding, norms and to_logits stay full
+    # precision.  "bf16" (the default) is the unchanged model.
+    weights: str = "bf16"
 
     @nn.compact
     def __call__(self, tokens, adapters=None, tenant=None):
@@ -506,6 +549,7 @@ class ProGen(nn.Module):
                 attn_impl=self.attn_impl,
                 mesh=self.mesh,
                 sow_caches=sow_caches,
+                weights=self.weights,
                 name=f"attn{i}",
             )(x, sin, cos, attn_ad, tenant)
             x = x + ff_cls(
@@ -519,6 +563,7 @@ class ProGen(nn.Module):
                 sgu_impl=self.sgu_impl,
                 mesh=self.mesh,
                 sow_caches=sow_caches,
+                weights=self.weights,
                 name=f"ff{i}",
             )(x, ff_ad, tenant)
             x = nn.with_logical_constraint(x, ("act_batch", "act_seq", "act_embed"))

@@ -48,6 +48,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from progen_tpu.decode.paging import DUMP_PAGE, NULL_PAGE
+from progen_tpu.ops.quant import quantize_rows
 
 
 def _mix_kernel(pos_ref, table_ref, w_ref, pool_ref, bias_ref, o_ref,
@@ -70,6 +71,45 @@ def _mix_kernel(pos_ref, table_ref, w_ref, pool_ref, bias_ref, o_ref,
         w = jnp.where(col <= pos, w_ref[...].astype(jnp.float32), 0.0)
         acc_ref[...] += jax.lax.dot_general(
             w, pool_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(p == pages_per_row - 1)
+    def _epilogue():
+        o_ref[...] = acc_ref[...] + bias_ref[...].astype(jnp.float32)
+
+
+def _mix_kernel_q8(pos_ref, table_ref, w_ref, pool_ref, bias_ref,
+                   wscale_ref, pscale_ref, o_ref, acc_ref, *,
+                   page_size, pages_per_row):
+    """Quantized variant of :func:`_mix_kernel`: dequant in the epilogue.
+
+    Int8 weight blocks and int8 pool pages are widened to f32 INSIDE the
+    kernel and multiplied by their scales — the per-weight-ROW scalar
+    (``wscale_ref``, indexed like the bias) and the per-pool-row scales
+    riding next to the page (``pscale_ref``, indexed like the page) — so
+    nothing 8-bit ever round-trips HBM at higher precision.  When one
+    side is full precision its scale pool is all ones and the multiply
+    is exact.
+    """
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[b]
+
+    @pl.when(p <= pos // page_size)
+    def _accumulate():
+        col = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        w = jnp.where(col <= pos, w_ref[...].astype(jnp.float32), 0.0)
+        w = w * wscale_ref[0, 0]
+        rows = pool_ref[0].astype(jnp.float32) * \
+            pscale_ref[...].reshape(page_size, 1)
+        acc_ref[...] += jax.lax.dot_general(
+            w, rows,
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     @pl.when(p == pages_per_row - 1)
@@ -120,35 +160,100 @@ def _pallas_mix(weights, biases, pool, table, pos, *, interpret):
     )(pos.astype(jnp.int32), table.astype(jnp.int32), weights, pool, biases)
 
 
-def _xla_mix(weights, biases, pool, table, pos, *, n_rows):
+def _pallas_mix_q8(weights, biases, pool, table, pos, w_scale, pool_scale,
+                   *, interpret):
+    """Quantized-path twin of :func:`_pallas_mix`: two extra scale
+    operands, same grid/ragged-walk structure, dequant in the kernel
+    epilogue (see :func:`_mix_kernel_q8`)."""
+    batch, pages_per_row = table.shape
+    num_pages, page_size, d = pool.shape
+    n = weights.shape[0]
+    span = pages_per_row * page_size
+    if span > n:
+        weights = jnp.pad(weights, ((0, 0), (0, span - n)))
+    biases = biases.reshape(n, 1).T  # (1, n) -> block (1, 1) at [0, pos]
+    # missing scales mean that side is full precision: all-ones is exact
+    if w_scale is None:
+        w_scale = jnp.ones((n,), jnp.float32)
+    if pool_scale is None:
+        pool_scale = jnp.ones((num_pages, page_size), jnp.float32)
+    w_scale = w_scale.astype(jnp.float32).reshape(1, n)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, pages_per_row),
+        in_specs=[
+            pl.BlockSpec((1, page_size),
+                         lambda b, p, pos_ref, table_ref: (pos_ref[b], p)),
+            pl.BlockSpec((1, page_size, d),
+                         lambda b, p, pos_ref, table_ref:
+                         (table_ref[b, p], 0, 0)),
+            pl.BlockSpec((1, 1),
+                         lambda b, p, pos_ref, table_ref: (0, pos_ref[b])),
+            # the weight ROW's scale: scalar block, indexed like the bias
+            pl.BlockSpec((1, 1),
+                         lambda b, p, pos_ref, table_ref: (0, pos_ref[b])),
+            # the pool page's per-row scales: indexed like the page
+            pl.BlockSpec((1, page_size),
+                         lambda b, p, pos_ref, table_ref:
+                         (table_ref[b, p], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d),
+                               lambda b, p, pos_ref, table_ref: (b, 0)),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+    )
+    kernel = functools.partial(_mix_kernel_q8, page_size=page_size,
+                               pages_per_row=pages_per_row)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, d), jnp.float32),
+        interpret=interpret,
+    )(pos.astype(jnp.int32), table.astype(jnp.int32), weights, pool, biases,
+      w_scale, pool_scale)
+
+
+def _xla_mix(weights, biases, pool, table, pos, *, n_rows,
+             w_scale=None, pool_scale=None):
     """Gather fallback, bit-matched to the dense decode contraction.
 
     Gathers each row's pages, slices to exactly ``n_rows`` (the dense
     engine's cache length) and runs the IDENTICAL masked f32 einsum the
     dense ``SGUDecode`` uses — stale rows in reused pages meet exact-zero
     causal weights, so the sums are bitwise those of the dense engine.
+    Under quantization the int8 weight rows / pool rows dequantize in f32
+    right after the gather (``w_scale`` per weight row, ``pool_scale``
+    per pool row), so the contraction itself is unchanged.
     """
     batch, pages_per_row = table.shape
     _, page_size, d = pool.shape
     rows = pool[table].reshape(batch, pages_per_row * page_size, d)
-    rows = rows[:, :n_rows]
+    rows = rows[:, :n_rows].astype(jnp.float32)
+    if pool_scale is not None:
+        ps = pool_scale[table].reshape(batch, pages_per_row * page_size)
+        rows = rows * ps[:, :n_rows, None]
     w_rows = weights.astype(jnp.float32)[pos][:, :n_rows]
+    if w_scale is not None:
+        w_rows = w_rows * w_scale.astype(jnp.float32)[pos][:, None]
     causal = jnp.arange(n_rows)[None, :] <= pos[:, None]
     w_rows = w_rows * causal.astype(jnp.float32)
-    mixed = jnp.einsum("bnd,bn->bd", rows.astype(jnp.float32), w_rows,
+    mixed = jnp.einsum("bnd,bn->bd", rows, w_rows,
                        preferred_element_type=jnp.float32)
     bias_m = biases.astype(jnp.float32)[pos]  # (B, 1), dense layout
     return mixed + bias_m
 
 
 def paged_gate_mix(weights, biases, pool, table, pos, *, n_rows,
-                   impl="xla", interpret=None):
+                   impl="xla", interpret=None, w_scale=None,
+                   pool_scale=None):
     """Ragged paged spatial-gate contraction.
 
     Args:
-      weights: ``(n, n)`` learned causal spatial weights.
+      weights: ``(n, n)`` learned causal spatial weights (f32, or int8
+        when ``w_scale`` is given).
       biases: ``(n, 1)`` spatial biases.
-      pool: ``(num_pages, page_size, d)`` global gate-row pool.
+      pool: ``(num_pages, page_size, d)`` global gate-row pool (compute
+        dtype, or int8 when ``pool_scale`` is given).
       table: ``(B, pages_per_row)`` int32 page table (NULL_PAGE for
         unowned entries).
       pos: ``(B,)`` int32 current positions.
@@ -158,31 +263,50 @@ def paged_gate_mix(weights, biases, pool, table, pos, *, n_rows,
       impl: ``"xla"`` (gather fallback) or ``"pallas"`` (ragged kernel).
       interpret: force/disable the Pallas interpreter; None auto-selects
         it off-TPU.
+      w_scale: optional ``(n,)`` f32 per-row scale for int8 weights.
+      pool_scale: optional ``(num_pages, page_size)`` f32 per-row scale
+        pool for int8 gate pages.
 
     Returns:
       ``(B, d)`` f32 ``mixed + bias`` (caller casts to the compute dtype
       and applies the gate multiply, matching dense ``SGUDecode``).
     """
     if impl == "xla":
-        return _xla_mix(weights, biases, pool, table, pos, n_rows=n_rows)
+        return _xla_mix(weights, biases, pool, table, pos, n_rows=n_rows,
+                        w_scale=w_scale, pool_scale=pool_scale)
     if impl != "pallas":
         raise ValueError(f"unknown paged gate impl: {impl!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _pallas_mix(weights, biases, pool, table, pos,
-                       interpret=interpret)
+    if w_scale is None and pool_scale is None:
+        # full precision keeps the ORIGINAL kernel: the bit-identity
+        # contract of the default path must not depend on all-ones
+        # multiplies optimizing away
+        return _pallas_mix(weights, biases, pool, table, pos,
+                           interpret=interpret)
+    return _pallas_mix_q8(weights, biases, pool, table, pos,
+                          w_scale, pool_scale, interpret=interpret)
 
 
-def write_gate_row(pool, table, pos, gate, write_ok):
+def write_gate_row(pool, table, pos, gate, write_ok, scale=None):
     """Scatter each live row's freshly computed gate into its page.
 
     Rows with ``write_ok=False`` (done / inactive / paused) and rows
     whose table entry is still NULL are redirected to the write-sink
     ``DUMP_PAGE`` — the scatter stays dense and unpredicated, and the
     zero page plus read-only shared pages are never clobbered.
+
+    With ``scale`` (the ``(num_pages, page_size)`` f32 scale pool of an
+    int8 gate pool) the row is quantized per-row on scatter — the int8
+    code and its f32 scale land through the SAME redirected target — and
+    the call returns ``(pool, scale)`` instead of ``pool``.
     """
     page_size = pool.shape[1]
     tgt = jnp.take_along_axis(table, (pos // page_size)[:, None],
                               axis=1)[:, 0]
     tgt = jnp.where(write_ok & (tgt != NULL_PAGE), tgt, DUMP_PAGE)
-    return pool.at[tgt, pos % page_size].set(gate)
+    if scale is None:
+        return pool.at[tgt, pos % page_size].set(gate)
+    q, s = quantize_rows(gate)
+    return (pool.at[tgt, pos % page_size].set(q),
+            scale.at[tgt, pos % page_size].set(s))

@@ -1,0 +1,208 @@
+"""Symmetric per-channel int8 weight quantization for the serving path.
+
+Weight-only int8 (the Gemma-on-TPU serving recipe, PAPERS.md): each
+weight matrix is quantized per OUTPUT channel with an absmax scale so a
+single f32 multiply in the matmul epilogue recovers the full-precision
+range.  The contraction itself runs int8-as-bf16 against the bf16
+activations with ``preferred_element_type=f32`` — ``[-127, 127]`` is
+exact in bf16 (8 mantissa bits), so the MXU accumulates the TRUE integer
+products in f32 and the only loss is the rounding taken at quantization
+time.  Scales never leave f32: multiplying them into a bf16 tensor would
+round twice.
+
+Three layers live here:
+
+* array-level: ``quantize_w`` / ``dequantize_w`` / ``int8_matmul`` plus
+  ``quantize_rows`` (per-row scaling for paged gate cache rows);
+* module-level: ``QuantDense`` — a drop-in for the model's ``nn.Dense``
+  sites that stores an int8 ``kernel`` in "params" and its f32 scale in
+  a parallel ``"qscale"`` collection, keeping the params tree structure
+  (leaf names, shapes-up-to-dtype) identical to the bf16 model so AOT
+  warmup, handoff slabs and LoRA banks work unchanged;
+* tree-level: ``quantize_params`` — walk a full-precision ProGen params
+  tree and emit ``(qparams, scales)`` ready to bind as
+  ``{"params": qparams, "qscale": scales}``.
+
+``np_*`` twins are pure-numpy oracles for tests; they must stay
+import-safe without jax.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from progen_tpu.core.precision import Policy
+
+# int8 symmetric range: +-127 keeps the code symmetric around zero (-128
+# is never produced) and both endpoints are exact in bf16.
+QMAX = 127.0
+
+
+def _scale_shape(ndim: int, channel_axis: int) -> list[int]:
+    shape = [1] * ndim
+    shape[channel_axis] = -1
+    return shape
+
+
+def quantize_w(w, channel_axis: int = -1):
+    """Symmetric per-channel absmax int8 quantization.
+
+    Returns ``(q, scale)``: ``q`` int8 with ``w``'s shape, ``scale`` f32
+    of shape ``(w.shape[channel_axis],)``.  All-zero channels get scale
+    1.0 so dequantization is well-defined (0 * 1.0 == 0.0 exactly).
+    """
+    w32 = jnp.asarray(w, jnp.float32)
+    channel_axis = channel_axis % w32.ndim
+    reduce_axes = tuple(a for a in range(w32.ndim) if a != channel_axis)
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes)
+    scale = jnp.where(absmax > 0.0, absmax / QMAX, 1.0)
+    s_b = scale.reshape(_scale_shape(w32.ndim, channel_axis))
+    q = jnp.clip(jnp.round(w32 / s_b), -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_w(q, scale, channel_axis: int = -1):
+    """Inverse of ``quantize_w`` up to rounding: f32 output."""
+    channel_axis = channel_axis % q.ndim
+    s_b = jnp.asarray(scale, jnp.float32).reshape(
+        _scale_shape(q.ndim, channel_axis))
+    return q.astype(jnp.float32) * s_b
+
+
+def int8_matmul(x, q, scale):
+    """``x @ dequantize(q, scale)`` with the dequant in the epilogue.
+
+    ``x`` is the bf16 activation ``(..., Din)``, ``q`` the int8 kernel
+    ``(Din, Dout)``, ``scale`` the f32 per-output-channel scale
+    ``(Dout,)``.  The int8 kernel is cast to ``x.dtype`` (exact for
+    ``[-127, 127]`` in bf16) so the contraction hits the MXU; the f32
+    accumulator result is scaled per channel in f32 and returned in f32
+    — callers cast once at the end.
+    """
+    y = jax.lax.dot_general(
+        x, q.astype(x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return y * scale.astype(jnp.float32)
+
+
+def quantize_rows(x):
+    """Per-row (last-axis) absmax int8 quantization for cache rows.
+
+    Returns ``(q, scale)`` with ``scale`` f32 of ``x.shape[:-1]``.  Used
+    by the 8-bit paged gate cache: one scale per gate row rides next to
+    the page in a parallel f32 pool.
+    """
+    x32 = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(absmax > 0.0, absmax / QMAX, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -QMAX, QMAX)
+    return q.astype(jnp.int8), scale
+
+
+class QuantDense(nn.Module):
+    """Drop-in for the model's ``nn.Dense`` sites under ``weights="int8"``.
+
+    Same param names as ``nn.Dense`` ("kernel", "bias") so the quantized
+    params tree has the structure of the bf16 tree with the kernel leaf
+    re-typed int8; the per-output-channel scale lives in the ``"qscale"``
+    collection as "kernel_scale".  Initialization yields zeros — real
+    serving always binds the output of ``quantize_params``.
+    """
+
+    features: int
+    use_bias: bool
+    axes: tuple[str, str]
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x):
+        d_in = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(nn.initializers.zeros, self.axes),
+            (d_in, self.features), jnp.int8)
+        scale = self.variable(
+            "qscale", "kernel_scale",
+            lambda: jnp.ones((self.features,), jnp.float32)).value
+        y = int8_matmul(x, kernel, scale).astype(self.policy.compute_dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros, (self.axes[-1],)),
+                (self.features,), self.policy.param_dtype)
+            y = y + bias.astype(self.policy.compute_dtype)
+        return y
+
+
+# kernels that must stay full precision: the logits head is the one
+# dense site whose rounding error lands directly on the sampled
+# distribution, and it is a single matmul per step — not worth it.
+_SKIP_SCOPES = ("to_logits",)
+
+
+def quantize_params(params):
+    """Quantize a full-precision ProGen "params" tree in one walk.
+
+    Returns ``(qparams, scales)``: ``qparams`` mirrors ``params`` with
+    every dense "kernel" leaf (except under ``to_logits``) re-typed int8
+    per output channel and each SGU "spatial_weights" leaf re-typed int8
+    per ROW (the row scale folds into the spatial mix, which contracts
+    over columns); embeddings, norms and biases pass through untouched.
+    ``scales`` is a sparse parallel tree holding the f32 scales under
+    "<leaf>_scale" names — bind both as
+    ``{"params": qparams, "qscale": scales}``.
+    """
+
+    def walk(tree, skip):
+        q, s = {}, {}
+        for k, v in tree.items():
+            if isinstance(v, Mapping):
+                sub_q, sub_s = walk(v, skip or k in _SKIP_SCOPES)
+                q[k] = sub_q
+                if sub_s:
+                    s[k] = sub_s
+            elif k == "kernel" and not skip:
+                q[k], s[k + "_scale"] = quantize_w(v, channel_axis=-1)
+            elif k == "spatial_weights":
+                q[k], s[k + "_scale"] = quantize_w(v, channel_axis=0)
+            else:
+                q[k] = v
+        return q, s
+
+    return walk(params, False)
+
+
+# ------------------------------------------------------------ numpy oracle
+
+
+def np_quantize_w(w, channel_axis: int = -1):
+    """Pure-numpy twin of ``quantize_w`` (same rounding: half-to-even)."""
+    w32 = np.asarray(w, np.float32)
+    channel_axis = channel_axis % w32.ndim
+    reduce_axes = tuple(a for a in range(w32.ndim) if a != channel_axis)
+    absmax = np.max(np.abs(w32), axis=reduce_axes)
+    scale = np.where(absmax > 0.0, absmax / QMAX, 1.0).astype(np.float32)
+    s_b = scale.reshape(_scale_shape(w32.ndim, channel_axis))
+    q = np.clip(np.round(w32 / s_b), -QMAX, QMAX).astype(np.int8)
+    return q, scale
+
+
+def np_dequantize_w(q, scale, channel_axis: int = -1):
+    channel_axis = channel_axis % q.ndim
+    s_b = np.asarray(scale, np.float32).reshape(
+        _scale_shape(q.ndim, channel_axis))
+    return q.astype(np.float32) * s_b
+
+
+def np_int8_matmul(x, q, scale):
+    """f32-exact oracle for ``int8_matmul`` (no bf16 cast of ``x``)."""
+    y = np.asarray(x, np.float32) @ q.astype(np.float32)
+    return y * np.asarray(scale, np.float32)

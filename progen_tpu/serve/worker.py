@@ -46,8 +46,10 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
               aot_warmup: bool = False,
               warmup_max_prime: int | None = None) -> dict:
     """Build the JSON-able worker spec.  ``engine`` holds
-    :class:`ServingEngine` kwargs (slots/chunk/paged/spec/...);
-    ``disagg`` is implied.  Params come from ``checkpoint_path`` when
+    :class:`ServingEngine` kwargs (slots/chunk/paged/spec/...,
+    including ``quantize`` — every worker built from the spec quantizes
+    the same full-precision init/checkpoint tree, so int8 replicas stay
+    bit-identical to each other); ``disagg`` is implied.  Params come from ``checkpoint_path`` when
     set, else from ``jit(model.init)(key(init_seed))`` — identical in
     every process either way.  ``trace`` (``{"dir": ..., "capacity"?}``)
     enables span tracing in every worker; each dumps its ring to

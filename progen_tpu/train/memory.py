@@ -403,6 +403,13 @@ class ServingMemoryPlan:
     # multi-tenant LoRA: the stacked (T, din, r)/(T, r, dout) adapter bank,
     # one copy shared by all slots
     adapter_bytes: int = 0
+    # resident weight bytes, both sides of the quantization decision:
+    # the f32 serving tree as-is, and the int8 re-typing (kernels 1 B +
+    # f32 per-channel scales; embed/norms/biases/logit head stay f32).
+    # Informational — NOT part of total_bytes, which has always counted
+    # only per-request decode state.
+    weight_bytes_full: int = 0
+    weight_bytes_int8: int = 0
 
     @property
     def fixed_bytes_per_slot(self) -> int:
@@ -423,13 +430,56 @@ class ServingMemoryPlan:
                 + self.handoff_bytes + self.adapter_bytes)
 
 
-def gate_row_bytes(cfg, mixed_precision: bool = True) -> int:
+def gate_row_bytes(cfg, mixed_precision: bool = True,
+                   gate_dtype: str = "bf16") -> int:
     """Bytes of ONE token row of SGU gate state across all gMLP layers —
-    the per-token unit both the dense slab and the page pool are made of."""
-    act = 2 if mixed_precision else 4
+    the per-token unit both the dense slab and the page pool are made of.
+
+    ``gate_dtype="int8"`` prices the 8-bit page format: 1 byte per
+    channel plus one f32 absmax scale per (row, layer) — ~2x smaller than
+    bf16 for any non-trivial ``half``."""
     gmlp_layers = sum(1 for i in range(cfg.depth) if cfg.layer_uses_gmlp(i))
     half = (cfg.dim * cfg.ff_mult) // 2
+    if gate_dtype == "int8":
+        return gmlp_layers * (half + 4)
+    if gate_dtype != "bf16":
+        raise ValueError(f"gate_dtype {gate_dtype!r}: want 'bf16' or 'int8'")
+    act = 2 if mixed_precision else 4
     return gmlp_layers * half * act
+
+
+def weight_hbm_bytes(cfg, *, quantize: bool = False) -> int:
+    """Resident weight bytes for a serving replica: the f32 tree as-is,
+    or the int8 re-typing under ``quantize`` — dense kernels and the SGU
+    spatial weights drop to 1 byte/element plus f32 per-channel (per-row
+    for spatial) scales; embed, norms, biases and the logit head stay
+    full precision, the same skip set as ``ops/quant.quantize_params``."""
+    if not quantize:
+        return count_params(cfg) * 4
+    d, inner = cfg.dim, cfg.heads * cfg.dim_head
+    n = cfg.num_tokens * d * 4  # embed stays f32
+    for i in range(cfg.depth):
+        gmlp = cfg.layer_uses_gmlp(i)
+        hidden = d * cfg.ff_mult * (1 if gmlp or not cfg.ff_glu else 2)
+        # attention: norm f32; qkv + out kernels int8 with f32 scales
+        n += d * 4
+        n += d * 3 * inner + 3 * inner * 4
+        n += inner * d + d * 4 + d * 4  # out kernel + scale + bias
+        # ff: norm f32; proj_in int8 + scale, f32 bias
+        n += d * 4
+        n += d * hidden + hidden * 4 + hidden * 4
+        if gmlp:
+            half = (d * cfg.ff_mult) // 2
+            L = cfg.seq_len
+            n += half * 4  # sgu norm
+            n += L * L + L * 4 + L * 4  # spatial int8 + row scale + bias
+            n += half * half + half * 4 + half * 4  # sgu proj_out
+            n += half * d + d * 4 + d * 4  # ff proj_out from half
+        else:
+            dout = hidden // (2 if cfg.ff_glu else 1)
+            n += dout * d + d * 4 + d * 4  # ff proj_out
+    n += d * 4 + d * cfg.num_tokens * 4 + cfg.num_tokens * 4  # logit head
+    return n
 
 
 def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
@@ -437,7 +487,8 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
                  page_size: int = 16, num_pages: int | None = None,
                  draft_cfg=None, disagg: bool = False,
                  handoff_depth: int = 2, lora_tenants: int = 0,
-                 lora_rank: int = 0) -> ServingMemoryPlan:
+                 lora_rank: int = 0,
+                 gate_dtype: str = "bf16") -> ServingMemoryPlan:
     """HBM accounting for a ServingEngine configuration (dense or paged).
 
     Mirrors ``decode/engine.py``'s state layout: k/v rings + carries +
@@ -457,7 +508,12 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
     The per-slot ``(max_len, vocab)`` bool logit mask (constrained
     infilling) is counted unconditionally — the engine allocates it for
     every configuration.  ``lora_tenants``/``lora_rank`` add the stacked
-    adapter bank (one copy, all slots share it)."""
+    adapter bank (one copy, all slots share it).
+
+    ``gate_dtype="int8"`` prices 8-bit gate pages: the POOL shrinks ~2x
+    while dense slabs, draft caches and handoff slabs stay in compute
+    dtype (quantization happens at the page-pool boundary).  Requires
+    ``paged=True``, mirroring the engine."""
     act = 2 if mixed_precision else 4
     L = min(max_len or cfg.seq_len, cfg.seq_len)
     ring = 2 * cfg.window_size
@@ -465,12 +521,16 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
     carry_b = cfg.depth * 2 * cfg.dim * act
     seq_b = L * 4
     lmask_b = L * cfg.num_tokens  # bool, 1 byte per (position, vocab) cell
+    if gate_dtype != "bf16" and not paged:
+        raise ValueError("gate_dtype='int8' requires paged=True — the "
+                         "8-bit gate format is a page format")
     row_b = gate_row_bytes(cfg, mixed_precision)
     pages_per_row = -(-L // page_size)
     if paged:
         if num_pages is None:
             num_pages = 2 + num_slots * pages_per_row
-        pool_b = num_pages * page_size * row_b
+        pool_b = num_pages * page_size * gate_row_bytes(
+            cfg, mixed_precision, gate_dtype=gate_dtype)
         gate_b = 0
         table_b = num_slots * pages_per_row * 4
     else:
@@ -508,14 +568,21 @@ def serving_plan(cfg, *, num_slots: int, max_len: int | None = None,
         handoff_bytes=handoff_b,
         lmask_bytes_per_slot=lmask_b,
         adapter_bytes=adapter_b,
+        weight_bytes_full=weight_hbm_bytes(cfg),
+        weight_bytes_int8=weight_hbm_bytes(cfg, quantize=True),
     )
 
 
 def equal_budget_pages(cfg, *, dense_slots: int, max_len: int,
-                       page_size: int = 16) -> int:
+                       page_size: int = 16,
+                       gate_dtype: str = "bf16") -> int:
     """Pool size (total pages, incl. the 2 reserved) whose gate-row bytes
     match what ``dense_slots`` fixed slots would pin: the equal-modeled-
-    HBM-budget comparison from the serving benchmark.  The row byte size
-    cancels, so this is just ``dense_slots * max_len`` token rows worth
-    of pages."""
-    return max(3, (dense_slots * max_len) // page_size)
+    HBM-budget comparison from the serving benchmark.  At ``bf16`` the
+    row byte size cancels and this is just ``dense_slots * max_len``
+    token rows worth of pages; at ``int8`` the same byte budget buys
+    ~2x the pages (dense slabs are always bf16 — that is the point of
+    the comparison)."""
+    budget = dense_slots * max_len * gate_row_bytes(cfg)
+    pool_row = gate_row_bytes(cfg, gate_dtype=gate_dtype)
+    return max(3, budget // (page_size * pool_row))

@@ -57,6 +57,14 @@ honor_env_platforms()
                    "bit-identical to the fixed-slot engine")
 @click.option("--page_size", default=16, help="engine: token rows per page "
                                               "(with --paged)")
+@click.option("--quantize", "quantize_mode", default=None,
+              type=click.Choice(["weights", "weights+pages"]),
+              help="engine: opt-in int8 serving — 'weights' re-types dense "
+                   "kernels and SGU spatial weights to int8 (f32 per-channel "
+                   "scales); 'weights+pages' additionally stores the paged "
+                   "SGU gate cache as 8-bit pages (requires --paged).  Full "
+                   "precision stays the default; accuracy is gated by "
+                   "bench_serving --verify (docs/SERVING.md §12)")
 @click.option("--serve_attempts", default=3,
               help="engine: total tries of the serve loop — a transient "
                    "failure snapshots the host-side request state, rebuilds "
@@ -143,7 +151,8 @@ honor_env_platforms()
                    "~/.cache/progen_tpu/xla")
 def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
          seq_len, mesh_spec, strategies, serve, embed_mode, infill, slots,
-         chunk, paged, page_size, serve_attempts, snapshot_path, aot_warmup,
+         chunk, paged, page_size, quantize_mode, serve_attempts,
+         snapshot_path, aot_warmup,
          spec, spec_k, disagg, serve_procs, prefill_procs, replicas,
          autoscale, min_prefill, max_prefill, min_replicas, max_replicas,
          swap_at, watchdog_timeout, statusz, trace, trace_out, xprof_dir,
@@ -289,7 +298,8 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
                 checkpoint_path=os.path.abspath(checkpoint_path),
                 engine=dict(num_slots=slots, chunk_size=chunk,
                             max_len=seq_len, paged=paged,
-                            page_size=page_size, spec=spec, spec_k=spec_k),
+                            page_size=page_size, spec=spec, spec_k=spec_k,
+                            quantize=quantize_mode),
                 trace=({"dir": os.path.abspath(trace_out)}
                        if trace else None),
                 statusz=statusz)
@@ -364,7 +374,7 @@ def main(seed, checkpoint_path, prime, top_k, temperature, num_samples,
             eng = ServingEngine(
                 model_config, {"params": params}, policy=policy,
                 num_slots=slots, chunk_size=chunk, max_len=seq_len,
-                paged=paged, page_size=page_size,
+                paged=paged, page_size=page_size, quantize=quantize_mode,
                 spec=spec, spec_k=spec_k, disagg=disagg,
                 mesh=mesh, strategies=strategy_list,
                 params_shardings=param_sh, watchdog=watchdog)

@@ -1,0 +1,509 @@
+"""Quantized serving tests: int8 weights, 8-bit paged gate pages, and
+the accuracy-verify tier's building blocks (docs/SERVING.md §12).
+
+The load-bearing ones:
+
+* oracle parity — ``quantize_w`` / ``int8_matmul`` agree with their
+  pure-numpy twins bit for bit (quantization) / to f32 tolerance
+  (contraction), and the rounding error respects the half-step bound;
+* tree shape — ``quantize_params`` preserves the params-tree structure
+  (AOT warmup / handoff / LoRA contract), skips the logits head, and
+  scales ``spatial_weights`` per ROW;
+* page parity — int8 gate pages written through ``write_gate_row`` and
+  read back through ``paged_gate_mix`` agree with the bf16 pool to
+  quantization tolerance, and the Pallas q8 kernel matches the XLA
+  gather fallback;
+* engine accuracy — greedy completions from quantized engines match the
+  full-precision engine at the verify tier's gate, the full-precision
+  default stays bit-identical, and snapshot/restore + reload_weights
+  keep working under quantization;
+* memory pins — the ~2x gate-row and ~4x weight HBM shrink ratios the
+  capacity table advertises are pinned against drift.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu import analysis
+from progen_tpu.analysis import engine as graft_engine
+from progen_tpu.core.precision import make_policy
+from progen_tpu.decode import Request, ServingEngine
+from progen_tpu.decode.incremental import init_gate_pool, init_gate_scale
+from progen_tpu.models import ProGen, ProGenConfig
+from progen_tpu.models.configs import DEFAULT
+from progen_tpu.ops.pallas_paged_attention import (
+    NULL_PAGE,
+    paged_gate_mix,
+    write_gate_row,
+)
+from progen_tpu.ops.quant import (
+    QMAX,
+    dequantize_w,
+    int8_matmul,
+    np_dequantize_w,
+    np_int8_matmul,
+    np_quantize_w,
+    quantize_params,
+    quantize_rows,
+    quantize_w,
+)
+from progen_tpu.parallel import unbox
+from progen_tpu.train.memory import (
+    count_params,
+    equal_budget_pages,
+    gate_row_bytes,
+    serving_plan,
+    weight_hbm_bytes,
+)
+
+pytestmark = pytest.mark.quant
+
+CFG = ProGenConfig(
+    num_tokens=32, dim=16, seq_len=24, depth=3, window_size=4,
+    global_mlp_depth=1, heads=2, dim_head=8, ff_mult=2,
+)
+
+MATCH_GATE = 0.98  # the verify tier's default --match-gate
+
+
+@pytest.fixture(scope="module")
+def trained():
+    policy = make_policy(False)  # f32 end to end: parity mode
+    model = ProGen(config=CFG, policy=policy)
+    tokens = jnp.zeros((2, CFG.seq_len), jnp.int32)
+    params = unbox(model.init(jax.random.key(7), tokens))
+    return model, params, policy
+
+
+def _mk_requests(n, *, max_new=8):
+    # request-set seed chosen so the tiny random-init fixture's greedy
+    # argmax margins clear the quantization noise (the verify tier's
+    # committed bench fixture is mined the same way, docs/SERVING.md §12)
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(n):
+        p = int(rng.integers(1, 9))
+        reqs.append(Request(
+            uid=i, tokens=rng.integers(1, CFG.num_tokens, p).tolist(),
+            max_new_tokens=max_new, top_k=None, temperature=0.0,
+            seed=100 + i,
+        ))
+    return reqs
+
+
+def _run_engine(params, policy, reqs, **kw):
+    eng = ServingEngine(CFG, params, policy=policy, **kw)
+    for r in reqs:
+        eng.submit(r)
+    comps = eng.run_until_idle(max_chunks=300)
+    return eng, {c.uid: c.tokens.tolist() for c in comps}
+
+
+def _match_rate(ref, got):
+    """The verify tier's score: summed per-request longest-common-prefix
+    over total reference tokens."""
+    total = sum(len(v) for v in ref.values())
+    agree = 0
+    for uid, want in ref.items():
+        have = got.get(uid, [])
+        for w, h in zip(want, have):
+            if w != h:
+                break
+            agree += 1
+    return agree / total
+
+
+# ---------------------------------------------------------------- arrays
+
+
+def test_quantize_w_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 24)).astype(np.float32)
+    for axis in (-1, 0):
+        q, s = quantize_w(w, channel_axis=axis)
+        nq, ns = np_quantize_w(w, channel_axis=axis)
+        np.testing.assert_array_equal(np.asarray(q), nq)
+        np.testing.assert_array_equal(np.asarray(s), ns)
+        assert np.asarray(q).dtype == np.int8
+        assert np.asarray(s).dtype == np.float32
+        np.testing.assert_allclose(
+            np.asarray(dequantize_w(q, s, channel_axis=axis)),
+            np_dequantize_w(nq, ns, channel_axis=axis), rtol=0, atol=0)
+
+
+def test_quantize_w_rounding_bound_and_zero_channels():
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(8, 6)).astype(np.float32)
+    w[:, 2] = 0.0  # an all-zero output channel
+    q, s = quantize_w(w)
+    s_np = np.asarray(s)
+    assert s_np[2] == 1.0  # zero channel: scale 1.0, dequant exact zero
+    back = np.asarray(dequantize_w(q, s))
+    np.testing.assert_array_equal(back[:, 2], 0.0)
+    # symmetric rounding: error at most half a quantization step per channel
+    assert np.all(np.abs(back - w) <= s_np[None, :] * 0.5 + 1e-7)
+    assert np.abs(np.asarray(q)).max() <= QMAX
+
+
+def test_int8_matmul_matches_numpy_oracle():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    q, s = np_quantize_w(w)
+    want = np_int8_matmul(x, q, s)
+    # f32 activations: same contraction up to reduction order
+    got = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(q),
+                                 jnp.asarray(s)))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    # bf16 activations: [-127, 127] is exact in bf16, so the only extra
+    # error is the bf16 rounding of x itself
+    xb = jnp.asarray(x, jnp.bfloat16)
+    got_b = np.asarray(int8_matmul(xb, jnp.asarray(q), jnp.asarray(s)))
+    want_b = np_int8_matmul(np.asarray(xb, np.float32), q, s)
+    np.testing.assert_allclose(got_b, want_b, rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_rows_roundtrip():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(5, 12)).astype(np.float32)
+    x[1] = 0.0
+    q, s = quantize_rows(x)
+    s_np, q_np = np.asarray(s), np.asarray(q)
+    assert q_np.dtype == np.int8 and s_np.shape == (5,)
+    assert s_np[1] == 1.0
+    back = q_np.astype(np.float32) * s_np[:, None]
+    np.testing.assert_array_equal(back[1], 0.0)
+    assert np.all(np.abs(back - x) <= s_np[:, None] * 0.5 + 1e-7)
+
+
+# ------------------------------------------------------------------ tree
+
+
+def test_quantize_params_preserves_structure_and_skips_logits(trained):
+    _, params, _ = trained
+    qtree, scales = quantize_params(params["params"])
+    # identical tree structure: AOT shapes / handoff slabs / LoRA paths
+    # carry over to the quantized engine unchanged
+    assert (jax.tree_util.tree_structure(qtree) ==
+            jax.tree_util.tree_structure(params["params"]))
+    flat = jax.tree_util.tree_flatten_with_path(qtree)[0]
+    for path, leaf in flat:
+        keys = [getattr(p, "key", "") for p in path]
+        name = keys[-1]
+        in_logits = "to_logits" in keys
+        if name == "kernel" and not in_logits:
+            assert leaf.dtype == jnp.int8, keys
+        elif name == "spatial_weights":
+            assert leaf.dtype == jnp.int8, keys
+        else:
+            # embeddings, norms, biases and the logits head stay put
+            orig = params["params"]
+            for k in keys:
+                orig = orig[k]
+            assert leaf.dtype == orig.dtype, keys
+    # spatial_weights is scaled per ROW (channel_axis=0): the row scale
+    # folds into the causal mix, which contracts over columns
+    sw_scales = [leaf for path, leaf in
+                 jax.tree_util.tree_flatten_with_path(scales)[0]
+                 if getattr(path[-1], "key", "") == "spatial_weights_scale"]
+    assert sw_scales, "no spatial_weights_scale leaves emitted"
+    n = CFG.seq_len
+    for s in sw_scales:
+        assert s.shape == (n,) and s.dtype == jnp.float32
+    # dequantized spatial weights stay close to the originals
+    flat_orig = {tuple(getattr(p, "key", "") for p in path): leaf
+                 for path, leaf in
+                 jax.tree_util.tree_flatten_with_path(params["params"])[0]}
+    flat_q = {tuple(getattr(p, "key", "") for p in path): leaf
+              for path, leaf in
+              jax.tree_util.tree_flatten_with_path(qtree)[0]}
+    flat_s = {tuple(getattr(p, "key", "") for p in path): leaf
+              for path, leaf in
+              jax.tree_util.tree_flatten_with_path(scales)[0]}
+    for keys, w in flat_orig.items():
+        if keys[-1] != "spatial_weights":
+            continue
+        q = flat_q[keys]
+        s = flat_s[keys[:-1] + ("spatial_weights_scale",)]
+        back = np.asarray(dequantize_w(q, s, channel_axis=0))
+        err = np.abs(back - np.asarray(w, np.float32))
+        assert np.all(err <= np.asarray(s)[:, None] * 0.5 + 1e-7)
+
+
+# ----------------------------------------------------------------- pages
+
+
+def test_int8_gate_pages_match_bf16_pool():
+    """Rows written int8 through ``write_gate_row`` and mixed through
+    ``paged_gate_mix`` agree with the bf16 pool to quantization
+    tolerance, and the Pallas q8 kernel matches the XLA fallback."""
+    rng = np.random.default_rng(4)
+    n, d, page_size, num_pages, batch = 12, 8, 4, 8, 2
+    pages_per_row = n // page_size
+    weights = np.tril(rng.normal(size=(n, n))).astype(np.float32)
+    biases = rng.normal(size=(n, 1)).astype(np.float32)
+    table = np.full((batch, pages_per_row), NULL_PAGE, np.int32)
+    table[0], table[1] = [2, 3, 4], [5, 6, 7]
+
+    pool_fp = jnp.zeros((num_pages, page_size, d), jnp.float32)
+    pool_q = jnp.zeros((num_pages, page_size, d), jnp.int8)
+    scale_q = jnp.ones((num_pages, page_size), jnp.float32)
+    tbl = jnp.asarray(table)
+    ok = jnp.ones((batch,), bool)
+    for t in range(n):
+        gate = jnp.asarray(rng.normal(size=(batch, d)), jnp.float32)
+        pos = jnp.full((batch,), t, jnp.int32)
+        pool_fp = write_gate_row(pool_fp, tbl, pos, gate, ok)
+        pool_q, scale_q = write_gate_row(pool_q, tbl, pos, gate, ok,
+                                         scale=scale_q)
+
+    qw, ws = quantize_w(jnp.asarray(weights), channel_axis=0)
+    pos = jnp.asarray([n - 1, n - 2], jnp.int32)
+    fp = np.asarray(paged_gate_mix(
+        jnp.asarray(weights), jnp.asarray(biases), pool_fp, tbl, pos,
+        n_rows=n, impl="xla"))
+    q_xla = np.asarray(paged_gate_mix(
+        qw, jnp.asarray(biases), pool_q, tbl, pos, n_rows=n, impl="xla",
+        w_scale=ws, pool_scale=scale_q))
+    q_pl = np.asarray(paged_gate_mix(
+        qw, jnp.asarray(biases), pool_q, tbl, pos, n_rows=n,
+        impl="pallas", interpret=True, w_scale=ws, pool_scale=scale_q))
+    # kernel vs fallback: same int8 inputs, same f32 math
+    np.testing.assert_allclose(q_pl, q_xla, rtol=1e-5, atol=1e-5)
+    # int8 vs full precision: bounded by the two rounding steps
+    np.testing.assert_allclose(q_xla, fp, rtol=0.05, atol=0.15)
+    # rows the causal mask excludes contribute exactly zero either way
+    assert not np.allclose(fp, 0.0)
+
+
+def test_init_gate_scale_mirrors_pool_layout():
+    pool = init_gate_pool(CFG, 6, 4, gate_dtype="int8")
+    scale = init_gate_scale(CFG, 6, 4)
+    assert set(pool) == set(scale)
+    for k in pool:
+        assert pool[k].dtype == jnp.int8
+        assert scale[k].shape == pool[k].shape[:2]
+        assert scale[k].dtype == jnp.float32
+        # ones-init: an unwritten row dequantizes to exact zero
+        assert float(jnp.min(scale[k])) == 1.0
+    with pytest.raises(ValueError):
+        init_gate_pool(CFG, 6, 4, gate_dtype="fp8")
+
+
+# ---------------------------------------------------------------- engine
+
+# shared engine knobs: every greedy run below uses the same shape so the
+# module fixture can drive each engine variant exactly once
+ENGINE_KW = dict(num_slots=3, chunk_size=4, max_len=20)
+
+
+@pytest.fixture(scope="module")
+def greedy_runs(trained):
+    """One greedy pass of the SAME request set through each engine
+    variant: full precision and quantized, dense and paged."""
+    _, params, policy = trained
+    out = {}
+    for name, kw in (
+        ("fp_dense", {}),
+        ("q_dense", {"quantize": "weights"}),
+        ("fp_paged", {"paged": True, "page_size": 4}),
+        ("q_paged", {"paged": True, "page_size": 4, "quantize": "weights"}),
+        ("q8_paged", {"paged": True, "page_size": 4,
+                      "quantize": "weights+pages"}),
+    ):
+        out[name] = _run_engine(params, policy, _mk_requests(6),
+                                **ENGINE_KW, **kw)
+    return out
+
+
+def test_engine_quant_weights_greedy_matches_fp(greedy_runs):
+    """Dense int8-weights engine: greedy completions match the
+    full-precision engine at (at least) the verify tier's gate."""
+    _, fp = greedy_runs["fp_dense"]
+    _, q = greedy_runs["q_dense"]
+    assert set(q) == set(range(6))
+    assert _match_rate(fp, q) >= MATCH_GATE
+
+
+def test_engine_quant_paged_matches_dense_quant(greedy_runs):
+    """int8 weights with bf16 pages: the paged engine stays
+    token-identical to the dense engine (the paged/dense bit-parity
+    contract survives weight quantization untouched)."""
+    assert greedy_runs["q_paged"][1] == greedy_runs["q_dense"][1]
+
+
+def test_engine_quant_pages_greedy_matches_fp(greedy_runs):
+    """int8 weights + int8 gate pages: still above the verify gate, and
+    the engine state carries the per-row scale pool."""
+    _, fp = greedy_runs["fp_paged"]
+    eng, q = greedy_runs["q8_paged"]
+    assert _match_rate(fp, q) >= MATCH_GATE
+    assert eng.gate_dtype == "int8"
+    assert "sgu_pool_scale" in eng.state["caches"]
+    assert eng._pool.stats()["gate_dtype"] == "int8"
+
+
+def test_engine_quant_rejects_pages_without_paged(trained):
+    _, params, policy = trained
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, policy=policy, num_slots=2,
+                      chunk_size=4, max_len=20, quantize="weights+pages")
+    with pytest.raises(ValueError):
+        ServingEngine(CFG, params, policy=policy, num_slots=2,
+                      chunk_size=4, max_len=20, quantize="int4")
+
+
+def test_full_precision_default_untouched(trained):
+    """No ``quantize``: no qscale collection, bf16 pages, params leaves
+    bit-identical to what was passed in — the default path cannot drift."""
+    _, params, policy = trained
+    eng = ServingEngine(CFG, params, policy=policy, num_slots=2,
+                        chunk_size=4, max_len=20, paged=True, page_size=4)
+    assert eng.quantize is None
+    assert eng.gate_dtype == "bf16"
+    assert "qscale" not in eng._params
+    assert "sgu_pool_scale" not in eng.state["caches"]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        eng._params["params"], params["params"])
+
+
+@pytest.mark.slow
+def test_engine_quant_deterministic_and_sharded(trained, devices8):
+    """Quantized SPMD: the int8 engine runs over an fsdp×tp mesh and two
+    identical runs agree token for token."""
+    from progen_tpu.core import MeshConfig, make_mesh
+    from progen_tpu.parallel.sharding import param_shardings
+
+    model, params, policy = trained
+    mesh = make_mesh(MeshConfig(data=1, fsdp=4, tensor=2),
+                     devices=devices8)
+    strategies = ("fsdp", "tp")
+    tokens = jnp.zeros((2, CFG.seq_len), jnp.int32)
+    shardings = param_shardings(model, tokens, mesh, strategies)["params"]
+
+    def run():
+        return _run_engine(
+            params, policy, _mk_requests(4, max_new=5), num_slots=2,
+            chunk_size=3, max_len=20, mesh=mesh, strategies=strategies,
+            params_shardings=shardings, quantize="weights")[1]
+
+    a, b = run(), run()
+    assert set(a) == set(range(4))
+    assert a == b
+
+
+def test_snapshot_restore_replay_quantized(trained, greedy_runs, tmp_path):
+    """snapshot -> restore -> replay is token-identical under
+    ``weights+pages`` quantization."""
+    _, params, policy = trained
+    kw = dict(**ENGINE_KW, paged=True, page_size=4,
+              quantize="weights+pages")
+    _, clean = greedy_runs["q8_paged"]  # the straight run, same knobs
+
+    eng = ServingEngine(CFG, params, policy=policy, **kw)
+    for r in _mk_requests(6):
+        eng.submit(r)
+    for _ in range(2):
+        eng.step()
+    path = str(tmp_path / "snap.json")
+    eng.snapshot(path)
+    pre = {c.uid: c.tokens.tolist() for c in eng.completions}
+
+    fresh = ServingEngine(CFG, params, policy=policy, **kw)
+    fresh.restore(path)
+    post = {c.uid: c.tokens.tolist()
+            for c in fresh.run_until_idle(max_chunks=300)}
+    assert {**pre, **post} == clean
+
+
+def test_reload_weights_requantizes(trained, greedy_runs):
+    """``reload_weights`` takes FULL-PRECISION trees and re-quantizes at
+    the door: a reloaded engine replays the original completions."""
+    _, params, _ = trained
+    eng, first = greedy_runs["q_dense"]
+    eng.reload_weights(params=params)
+    for r in _mk_requests(6):
+        eng.submit(r)
+    again = {c.uid: c.tokens.tolist()
+             for c in eng.run_until_idle(max_chunks=300)}
+    assert again == first
+
+
+# ---------------------------------------------------------------- memory
+
+
+def test_gate_row_bytes_int8_ratio_pinned():
+    full = gate_row_bytes(DEFAULT)
+    q8 = gate_row_bytes(DEFAULT, gate_dtype="int8")
+    assert full == 1024 and q8 == 520  # 2 gMLP layers x (256x2 | 256+4)
+    ratio = full / q8
+    assert 1.9 <= ratio < 2.0  # ~2x minus the 4-byte per-row f32 scale
+    assert gate_row_bytes(DEFAULT, gate_dtype="bf16") == full
+    with pytest.raises(ValueError):
+        gate_row_bytes(DEFAULT, gate_dtype="fp8")
+
+
+def test_weight_hbm_bytes_int8_ratio_pinned():
+    full = weight_hbm_bytes(DEFAULT)
+    q8 = weight_hbm_bytes(DEFAULT, quantize=True)
+    assert full == count_params(DEFAULT) * 4
+    assert full / q8 >= 3.5  # embeddings/norms/logits head stay f32
+    assert q8 < full
+
+
+def test_equal_budget_pages_gate_dtype():
+    kw = dict(dense_slots=4, max_len=DEFAULT.seq_len, page_size=8)
+    base = equal_budget_pages(DEFAULT, **kw)
+    # bf16 is bit-compatible with the pre-quantization signature
+    assert equal_budget_pages(DEFAULT, **kw, gate_dtype="bf16") == base
+    q8 = equal_budget_pages(DEFAULT, **kw, gate_dtype="int8")
+    # same HBM budget buys ~2x the pages in the int8 format
+    assert 1.9 <= q8 / base < 2.0
+
+
+def test_serving_plan_quant_fields():
+    plan = serving_plan(DEFAULT, num_slots=4, paged=True, num_pages=64,
+                        page_size=8, gate_dtype="int8")
+    assert plan.weight_bytes_full == weight_hbm_bytes(DEFAULT)
+    assert plan.weight_bytes_int8 == weight_hbm_bytes(DEFAULT,
+                                                      quantize=True)
+    fp_plan = serving_plan(DEFAULT, num_slots=4, paged=True, num_pages=64,
+                           page_size=8)
+    ratio = fp_plan.pool_bytes / plan.pool_bytes
+    assert 1.9 <= ratio < 2.0
+    with pytest.raises(ValueError):
+        serving_plan(DEFAULT, num_slots=4, gate_dtype="int8")
+
+
+# ------------------------------------------------------------- graftcheck
+
+
+def test_graftcheck_dtype_rules_cover_quant():
+    """The dtype-pet rule owns ops/quant.py: a bare int8 dot_general
+    there fires, and the REAL module scans clean."""
+    import textwrap
+    from pathlib import Path
+
+    analysis.load_rules()
+    findings = graft_engine.check_source(
+        textwrap.dedent(
+            """
+            import jax
+
+            def int8_matmul(x, q, scale):
+                y = jax.lax.dot_general(
+                    x, q.astype(x.dtype),
+                    (((x.ndim - 1,), (0,)), ((), ())))
+                return y * scale
+            """),
+        path="progen_tpu/ops/quant.py", rules=["dtype-pet"])
+    assert [f.rule for f in findings] == ["dtype-pet"]
+
+    real = (Path(__file__).resolve().parent.parent /
+            "progen_tpu" / "ops" / "quant.py").read_text()
+    assert graft_engine.check_source(
+        real, path="progen_tpu/ops/quant.py", rules=None) == []

@@ -68,6 +68,16 @@ WATCHED: dict[str, tuple[int, float]] = {
     # on shared runners, so its band stays wide
     "fleet_prefix_hit_rate": (+1, 0.25),
     "ttft_p95": (-1, 0.50),
+    # quantized serving (bench_serving.py --quantize, docs/SERVING.md
+    # §12): the greedy token-match rate is deterministic on the
+    # committed fixture schedule, so ANY drop below the committed
+    # baseline is a real accuracy regression (zero band = floor gate);
+    # equal-HBM in-flight capacity is closed-form from the pool budget
+    # (tiny band absorbs reserved-page rounding); quant throughput gets
+    # the usual wall-clock band
+    "token_match_rate": (+1, 0.0),
+    "equal_hbm_inflight": (+1, 0.02),
+    "quant_decode_tok_s": (+1, 0.30),
 }
 
 
@@ -164,6 +174,13 @@ def main(argv=None) -> int:
     compared = 0
     for fam in shared:
         b, c = base[fam], cand[fam]
+        bh, ch = b.get("schedule_hash"), c.get("schedule_hash")
+        if bh is not None and ch is not None and bh != ch:
+            # records were driven on different request schedules —
+            # token-match and throughput numbers are not comparable
+            print(f"{fam}: skipped (schedule_hash {bh} != {ch}; "
+                  f"re-baseline with the same fixture schedule)")
+            continue
         print(f"{fam}: baseline sha {b.get('git_sha', '?')[:12]} -> "
               f"candidate sha {c.get('git_sha', '?')[:12]}")
         rows = compare(b, c, bands)

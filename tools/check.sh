@@ -136,6 +136,47 @@ if python tools/benchdiff.py --metric serving_qos \
     exit 1
 fi
 
+echo "== quant smoke =="
+# int8 weights + 8-bit gate pages on the committed CPU fixture schedule
+# (benchmarks/quant.jsonl uses the same seed/args), with the accuracy
+# tier live: --verify fails the run if the greedy token-match rate vs
+# the in-process full-precision engine drops below the 0.98 gate
+# (docs/SERVING.md §12)
+JAX_PLATFORMS=cpu python benchmarks/bench_serving.py \
+    --config default --requests 6 --rate 50 --slots 3 --chunk 8 \
+    --max-new 8 --prime-min 4 --prime-max 16 --seed 9 \
+    --paged --page-size 8 --budget-slots 8 \
+    --quantize weights+pages --verify --out "$BENCH_DIR/quant.jsonl"
+# floor-gate the deterministic fields against the committed baseline:
+# token_match_rate (zero band — any drop is a real accuracy regression)
+# and equal_hbm_inflight (closed-form pool capacity).  Wall-clock
+# throughput/latency fields get throwaway bands here: this leg runs on
+# arbitrary CI hardware
+python tools/benchdiff.py benchmarks/quant.jsonl "$BENCH_DIR/quant.jsonl" \
+    --band tokens_per_sec=100 --band quant_decode_tok_s=100 \
+    --band p50_latency_s=100 --band p95_latency_s=100 --band wall_s=100
+# injected token-match regression MUST fail the gate: a quantization
+# change that flips even one greedy token cannot ship silently
+python - "$BENCH_DIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+recs = [json.loads(ln) for ln in open(f"{d}/quant.jsonl")]
+for rec in recs:
+    if "token_match_rate" in rec:
+        rec["token_match_rate"] -= 0.05   # one flipped token's worth
+        rec["wall_time"] = rec.get("wall_time", 0) + 1
+open(f"{d}/quant_bad.jsonl", "w").write(
+    "".join(json.dumps(r) + "\n" for r in recs))
+EOF
+if python tools/benchdiff.py "$BENCH_DIR/quant.jsonl" \
+        "$BENCH_DIR/quant_bad.jsonl" \
+        --band tokens_per_sec=100 --band quant_decode_tok_s=100 \
+        --band p50_latency_s=100 --band p95_latency_s=100 \
+        --band wall_s=100; then
+    echo "benchdiff FAILED to flag an injected token-match regression" >&2
+    exit 1
+fi
+
 echo "== fleetcache smoke =="
 # fleet prefix cache on a real cluster (prefill worker + 2 decode
 # replicas): a Zipf popular-prompt schedule runs cache-aware vs
