@@ -87,6 +87,9 @@ def time_one(run, n: int, d: int, batch: int) -> float:
     k1, k2, k3, k4 = jax.random.split(jax.random.key(0), 4)
     res = jax.random.normal(k1, (batch, n, d), jnp.bfloat16)
     gate = jax.random.normal(k2, (batch, n, d), jnp.bfloat16)
+    # benchmark input magnitude only — bf16 rounding of the scale
+    # cannot affect a timing measurement
+    # graftcheck: disable=dtype-f32-literal
     w = jax.random.normal(k3, (n, n), jnp.bfloat16) * 0.001
     bias = jnp.ones((n, 1), jnp.bfloat16)
     t0 = time.perf_counter()

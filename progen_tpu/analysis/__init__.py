@@ -24,6 +24,7 @@ from progen_tpu.analysis.engine import (  # noqa: F401
     check_source,
     format_human,
     format_json,
+    format_sarif,
     load_baseline,
     run,
     save_baseline,
@@ -37,6 +38,9 @@ _RULE_MODULES = (
     "rules_hostsync",
     "rules_jit",
     "rules_pallas",
+    "rules_lifecycle",
+    "rules_wire",
+    "rules_determinism",
 )
 
 

@@ -21,8 +21,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import re
+import tokenize
 from pathlib import Path
 from typing import Callable, Iterable, Sequence
 
@@ -136,12 +138,39 @@ def build_context(root: Path) -> RepoContext:
 # ---------------------------------------------------------------------------
 
 
+def _comment_lines(lines: Sequence[str]):
+    """``(lineno, line_text)`` for lines carrying a real COMMENT token.
+    Tokenizing (rather than regexing every line) keeps suppression
+    examples inside docstrings — like the ones at the top of this file —
+    from acting as live suppressions or rotting into stale ones."""
+    src = "\n".join(lines) + "\n"
+    comment_rows: set[int] | None = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                comment_rows.add(tok.start[0])
+    except (tokenize.TokenError, SyntaxError, IndentationError, ValueError):
+        comment_rows = None  # unterminated string etc: fall back to all lines
+    for i, text in enumerate(lines, start=1):
+        if comment_rows is None or i in comment_rows:
+            yield i, text
+
+
 class Suppressions:
+    """Parses the suppression comments of one file and, while findings
+    are checked against it, records which entries actually fired — a
+    suppression that never matches anything is itself reportable (the
+    ``stale-suppression`` rule) so sanctioned-leak comments can't
+    outlive the code they sanction."""
+
     def __init__(self, lines: Sequence[str]):
         self.by_line: dict[int, set[str]] = {}
         self.file_wide: set[str] = set()
         self._comment_only: set[int] = set()
-        for i, text in enumerate(lines, start=1):
+        # entries that matched at least one finding: (line, rule) for
+        # per-line entries, (0, rule) for file-wide ones
+        self.matched: set[tuple[int, str]] = set()
+        for i, text in _comment_lines(lines):
             m = _SUPPRESS_FILE_RE.search(text)
             if m:
                 self.file_wide.update(m.group(1).split(","))
@@ -152,7 +181,12 @@ class Suppressions:
                 self._comment_only.add(i)
 
     def is_suppressed(self, finding: Finding) -> bool:
-        if finding.rule in self.file_wide or "all" in self.file_wide:
+        hit = False
+        for name in (finding.rule, "all"):
+            if name in self.file_wide:
+                self.matched.add((0, name))
+                hit = True
+        if hit:
             return True
         for line in (finding.line, finding.line - 1):
             rules = self.by_line.get(line)
@@ -160,9 +194,41 @@ class Suppressions:
                 continue
             if line != finding.line and line not in self._comment_only:
                 continue  # trailing comment on the previous code line: no
-            if finding.rule in rules or "all" in rules:
+            for name in (finding.rule, "all"):
+                if name in rules:
+                    self.matched.add((line, name))
+                    hit = True
+        return hit
+
+    def stale_findings(self, path: str,
+                       rules_run: set | None = None) -> list[Finding]:
+        """Suppression entries that no finding ever matched.  With a
+        rule filter active, entries naming rules that didn't run are
+        skipped — their target simply wasn't looked for."""
+
+        def eligible(name: str) -> bool:
+            if rules_run is None:
                 return True
-        return False
+            return name == "all" or name in rules_run
+
+        out: list[Finding] = []
+        for line in sorted(self.by_line):
+            for name in sorted(self.by_line[line]):
+                if eligible(name) and (line, name) not in self.matched:
+                    out.append(Finding(
+                        rule="stale-suppression", path=path, line=line,
+                        col=0,
+                        message=f"suppression 'graftcheck: disable={name}' "
+                                "never matched a finding — the sanctioned "
+                                "code is gone, delete the comment"))
+        for name in sorted(self.file_wide):
+            if eligible(name) and (0, name) not in self.matched:
+                out.append(Finding(
+                    rule="stale-suppression", path=path, line=1, col=0,
+                    message=f"suppression 'graftcheck: disable-file={name}' "
+                            "never matched a finding — the sanctioned "
+                            "code is gone, delete the comment"))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -235,19 +301,23 @@ def check_source(
     path: str = "<string>",
     rules: Sequence[str] | None = None,
     ctx: RepoContext | None = None,
+    report_stale: bool = False,
 ) -> list[Finding]:
     """Analyze a source string — the unit-test entry point."""
     tree = ast.parse(source)
     module = ParsedModule(
         path=path, source=source, tree=tree, lines=source.splitlines()
     )
-    return check_module(module, ctx or RepoContext(root=Path(".")), rules)
+    return check_module(module, ctx or RepoContext(root=Path(".")), rules,
+                        report_stale=report_stale)
 
 
 def check_module(
     module: ParsedModule,
     ctx: RepoContext,
     rules: Sequence[str] | None = None,
+    *,
+    report_stale: bool = False,
 ) -> list[Finding]:
     suppress = Suppressions(module.lines)
     out: list[Finding] = []
@@ -255,6 +325,13 @@ def check_module(
         if rules is not None and name not in rules:
             continue
         for finding in fn(module, ctx):
+            if not suppress.is_suppressed(finding):
+                out.append(finding)
+    if report_stale:
+        rules_run = None if rules is None else set(rules)
+        for finding in suppress.stale_findings(module.path, rules_run):
+            # a stale-suppression finding is suppressible like any other
+            # (and doing so un-stales the entry that names it)
             if not suppress.is_suppressed(finding):
                 out.append(finding)
     out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
@@ -265,6 +342,8 @@ def run(
     paths: Sequence[Path],
     root: Path,
     rules: Sequence[str] | None = None,
+    *,
+    report_stale: bool = False,
 ) -> list[Finding]:
     # rule modules register themselves on import; keep this lazy so that
     # `from progen_tpu.analysis import engine` alone stays import-cycle free
@@ -286,7 +365,8 @@ def run(
                 )
             )
             continue
-        findings.extend(check_module(module, ctx, rules))
+        findings.extend(check_module(module, ctx, rules,
+                                     report_stale=report_stale))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -317,3 +397,51 @@ def format_json(findings: Sequence[Finding], baselined: int = 0) -> str:
         },
         indent=2,
     )
+
+
+def format_sarif(findings: Sequence[Finding], baselined: int = 0) -> str:
+    """SARIF 2.1.0 — the interchange format CI annotators and editors
+    consume; one run, one result per finding, columns 1-based."""
+    rule_ids = sorted({f.rule for f in findings})
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(1, f.line),
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": "https://docs.oasis-open.org/sarif/sarif/v2.1.0/os/"
+                   "schemas/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "informationUri":
+                            "https://example.invalid/progen-tpu/graftcheck",
+                        "rules": [{"id": r} for r in rule_ids],
+                    }
+                },
+                "results": results,
+                "properties": {"baselined": baselined},
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

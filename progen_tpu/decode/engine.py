@@ -224,6 +224,11 @@ class Completion:
     # cluster rewrites this onto the driver clock so ``ttft`` is
     # end-to-end (queue + prefill + transport + merge) fleet-wide.
     first_token_time: float | None = None
+    # latency as measured on the WORKER's clock (submit→finish inside
+    # the remote engine); 0.0 for local completions, where ``latency``
+    # already is that number.  The difference vs ``latency`` is the
+    # transport + merge overhead the fleet adds on top of the engine.
+    worker_latency: float = 0.0
 
     @property
     def latency(self) -> float:
@@ -1825,7 +1830,7 @@ class ServingEngine:
             for r in reversed(batch):
                 self._embed_queue.appendleft(r)
             raise
-        vecs = np.asarray(jax.device_get(  # graftcheck: disable=host-sync
+        vecs = np.asarray(jax.device_get(
             vecs))
         now = time.perf_counter()
         for row, r in enumerate(batch):
@@ -1935,6 +1940,10 @@ class ServingEngine:
                            for _, r in live_rows)
                 if not self._pool.can_allocate(need):
                     return
+            # peek-then-pop: ``h`` above came from front() without
+            # consuming; this get() pops that same handle now that
+            # admission is committed — ownership continues in ``h``
+            # graftcheck: disable=resource-leak
             self._handoff.get()
             if live_rows:
                 src = np.zeros((self.num_slots,), np.int32)

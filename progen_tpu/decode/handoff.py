@@ -333,6 +333,14 @@ def request_from_wire(d: dict, *, now: float | None = None,
         priority=int(d.get("priority", 0)))
     if "deadline_remaining" in d:
         r.deadline = now + float(d["deadline_remaining"])
+    tc = d.get("trace")
+    if tc:
+        # land the sender's clock instant on this process's timeline so
+        # the offset-corrected merge can attribute cross-process queue
+        # wait to the request (docs/OBSERVABILITY.md)
+        _trace.get_tracer().event("request.arrive", trace=tc.get("id"),
+                                  sender_clock=tc.get("clock"),
+                                  recv_clock=now)
     return r
 
 

@@ -63,7 +63,8 @@ def _completion_from_wire(header: dict, submit_time: float,
         finish_reason=header["finish_reason"],
         submit_time=submit_time, finish_time=finish_time,
         status=header.get("status", "ok"),
-        embedding=None if emb is None else np.asarray(emb, np.float32))
+        embedding=None if emb is None else np.asarray(emb, np.float32),
+        worker_latency=float(header.get("worker_latency", 0.0)))
 
 
 def _shed_completion(request, status: str, now: float) -> Completion:
@@ -727,6 +728,22 @@ class ServeCluster:
         batch_id = header.get("batch_id")
         uids = [d["uid"] for d in header.get("reqs", [])]
         self.router.note_handle(batch_id, uids, peer.index)
+        # routing tags the producer stamped on the handle: another clock
+        # echo to tighten the producer's offset estimate, plus two
+        # desync tripwires (identity and weight generation) that surface
+        # in the trace rather than changing routing — the connection and
+        # the router's own bookkeeping stay authoritative
+        tc = header.get("trace_ctx") or {}
+        self._note_clock("prefill", peer.index, tc.get("clock"))
+        src = header.get("src")
+        if src is not None and src != peer.index:
+            self._tracer.event("handle.src_mismatch", batch_id=batch_id,
+                               claimed=src, connection=peer.index)
+        gen = header.get("generation")
+        noted_gen = self.router.batch_generation(batch_id)
+        if gen is not None and noted_gen is not None and gen != noted_gen:
+            self._tracer.event("handle.generation_skew", batch_id=batch_id,
+                               header_generation=gen, noted=noted_gen)
         # the handle carries each request's first sampled token, so its
         # arrival is the driver-observed TTFT (submit and arrival are
         # both driver clock — no cross-process correction needed); a

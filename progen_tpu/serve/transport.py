@@ -107,6 +107,11 @@ def connect(port: int, *, host: str = "127.0.0.1", timeout: float = 60.0,
     while time.perf_counter() < deadline:
         try:
             sock = socket.create_connection((host, port), timeout=timeout)
+        except OSError as e:
+            last = e
+            time.sleep(retry_every)
+            continue
+        try:
             # the timeout bounds the CONNECT attempt only: the reader
             # thread blocks in recv() across idle lulls (a prefill
             # worker between requests, a replica mid-decode), and an
@@ -116,6 +121,7 @@ def connect(port: int, *, host: str = "127.0.0.1", timeout: float = 60.0,
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return sock
         except OSError as e:
+            sock.close()
             last = e
             time.sleep(retry_every)
     raise ConnectionError(f"could not reach router on port {port}: {last}")

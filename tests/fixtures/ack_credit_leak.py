@@ -1,0 +1,43 @@
+"""The PR 9 ack-credit leak, preserved as an analyzer regression fixture.
+
+``leaky_on_handle`` is the shape of the bug that shipped: when no decode
+replica is placeable, the batch is requeued or shed WITHOUT returning
+the producer's ack credit — after ``handoff_depth`` such drops the
+prefill worker's unacked window is full and the fleet wedges on drain.
+``fixed_on_handle`` is the shipped fix (credit returned on every drop
+path).  ``tests/test_graftcheck.py`` asserts the resource-leak pass
+flags exactly the leaky variant — regression-proofing the ANALYZER, not
+the serving code.
+
+This file is never imported by the fleet; it exists to be parsed.
+"""
+
+
+def leaky_on_handle(self, peer, header, frame):
+    batch_id = header.get("batch_id")
+    uids = [d["uid"] for d in header.get("reqs", [])]
+    self.router.note_handle(batch_id, uids, peer.index)
+    r = self.router.pick_replica(self.router.batch_generation(batch_id))
+    if r is None:
+        # BUG (reverted PR 9 review fix): this batch will never reach
+        # replica admission, but its credit is not returned before the
+        # requests are requeued/shed — the producer's window leaks a slot
+        for uid in self.router.requeue(uids):
+            self._shed(uid, "failed_fault", 0.0)
+        return
+    self.router.forward(batch_id, r, 0.0)
+    self._relay(r, frame)
+
+
+def fixed_on_handle(self, peer, header, frame):
+    batch_id = header.get("batch_id")
+    uids = [d["uid"] for d in header.get("reqs", [])]
+    self.router.note_handle(batch_id, uids, peer.index)
+    r = self.router.pick_replica(self.router.batch_generation(batch_id))
+    if r is None:
+        self._return_credit(batch_id)
+        for uid in self.router.requeue(uids):
+            self._shed(uid, "failed_fault", 0.0)
+        return
+    self.router.forward(batch_id, r, 0.0)
+    self._relay(r, frame)

@@ -2,6 +2,7 @@
 suppression + baseline mechanics, JSON output schema, CLI exit codes, and
 the repo-wide zero-findings gate that makes the analyzer a tier-1 check."""
 
+import ast
 import json
 import subprocess
 import sys
@@ -11,6 +12,7 @@ from pathlib import Path
 import pytest
 
 from progen_tpu import analysis
+from progen_tpu.analysis import cfg as cfg_mod
 from progen_tpu.analysis import engine
 
 pytestmark = pytest.mark.analysis
@@ -840,14 +842,645 @@ def test_repo_wide_zero_findings_gate():
     targets = [
         REPO_ROOT / "progen_tpu",
         REPO_ROOT / "tools",
+        REPO_ROOT / "benchmarks",
         REPO_ROOT / "train.py",
         REPO_ROOT / "sample.py",
         REPO_ROOT / "bench.py",
+        REPO_ROOT / "generate_data.py",
     ]
-    findings = analysis.run(targets, root=REPO_ROOT)
+    findings = analysis.run(targets, root=REPO_ROOT, report_stale=True)
     baseline_path = REPO_ROOT / "tools" / "graftcheck_baseline.json"
     baseline = (
         engine.load_baseline(baseline_path) if baseline_path.is_file() else set()
     )
     new, _ = engine.apply_baseline(findings, baseline)
     assert not new, "\n" + engine.format_human(new)
+
+
+# ---------------------------------------------------------------------------
+# cfg: hand-drawn graph checks
+# ---------------------------------------------------------------------------
+
+
+def _cfg(source):
+    tree = ast.parse(textwrap.dedent(source))
+    return cfg_mod.build_cfg(tree.body[0])
+
+
+def test_cfg_if_else_hand_drawn():
+    g = _cfg(
+        """
+        def f(a):
+            x = 1
+            if a:
+                y = 2
+            else:
+                y = 3
+            return y
+        """
+    )
+    (branch,) = [n for n in g.nodes if n.kind == "branch"]
+    assert {lab for _, lab in g.successors(branch.idx)} == {"true", "false"}
+    (ret,) = [n for n in g.nodes if n.kind == "return"]
+    # both arms reconverge on the return, which reaches exit
+    for dst, _ in g.successors(branch.idx):
+        assert ret.idx in g.reachable_from(dst)
+    assert g.exit in g.reachable_from(g.entry)
+
+
+def test_cfg_while_loop_back_edge():
+    g = _cfg(
+        """
+        def f(n):
+            while n:
+                n = step(n)
+            return n
+        """
+    )
+    (branch,) = [n for n in g.nodes if n.kind == "branch"]
+    (body,) = [n for n in g.nodes if n.kind == "stmt" and n.line == 4]
+    assert (body.idx, "true") in g.successors(branch.idx)
+    assert (branch.idx, "norm") in g.successors(body.idx)  # the back edge
+    (ret,) = [n for n in g.nodes if n.kind == "return"]
+    assert (ret.idx, "false") in g.successors(branch.idx)
+
+
+def test_cfg_early_return_skips_following_code():
+    g = _cfg(
+        """
+        def f(a):
+            if a:
+                return 1
+            tail(a)
+            return 2
+        """
+    )
+    (early,) = [n for n in g.nodes if n.kind == "return" and n.line == 4]
+    (tail,) = [n for n in g.nodes if n.kind == "stmt" and n.line == 5]
+    reach = g.reachable_from(early.idx)
+    assert g.exit in reach
+    assert tail.idx not in reach
+
+
+def test_cfg_finally_runs_on_both_continuations():
+    g = _cfg(
+        """
+        def f(a):
+            try:
+                work(a)
+            finally:
+                cleanup(a)
+            return a
+        """
+    )
+    # the finally body is instantiated once per continuation purpose:
+    # fall-through and the exception path both execute cleanup
+    copies = g.nodes_for_line(6)
+    assert len(copies) >= 2
+    (ret,) = [n for n in g.nodes if n.kind == "return"]
+    assert any(ret.idx in g.reachable_from(c.idx) for c in copies)
+    assert any(g.raise_exit in g.reachable_from(c.idx) for c in copies)
+
+
+def test_cfg_exception_edge_reaches_handler():
+    g = _cfg(
+        """
+        def f(a):
+            try:
+                risky(a)
+            except ValueError:
+                a = 0
+            return a
+        """
+    )
+    (body,) = [n for n in g.nodes if n.kind == "stmt" and n.line == 4]
+    (handler,) = [n for n in g.nodes if n.kind == "except"]
+    assert (handler.idx, "exc") in g.successors(body.idx)
+    # ValueError is not a catch-all: the exception may also propagate
+    assert (g.raise_exit, "exc") in g.successors(body.idx)
+
+
+def test_forward_dataflow_reaches_fixpoint_on_loop():
+    g = _cfg(
+        """
+        def f(a):
+            x = 1
+            while a:
+                x = x + 1
+            return x
+        """
+    )
+    states = cfg_mod.forward_dataflow(
+        g,
+        init=frozenset(),
+        transfer=lambda node, state, label: state | {node.kind},
+        join=lambda a, b: a | b,
+    )
+    assert "entry" in states[g.exit]
+    assert "branch" in states[g.exit]
+    assert "return" in states[g.exit]
+
+
+# ---------------------------------------------------------------------------
+# resource-leak (path-sensitive lifecycle)
+# ---------------------------------------------------------------------------
+
+
+def test_resource_leak_flags_exception_path():
+    findings = check(
+        """
+        def admit(pool, n, bad):
+            pages = pool.allocate(n)
+            if bad:
+                raise ValueError("no capacity")
+            pool.release(pages)
+        """,
+        rules=["resource-leak"],
+    )
+    assert rule_names(findings) == ["resource-leak"]
+    assert "raise propagates" in findings[0].message
+
+
+def test_resource_leak_flags_early_return():
+    findings = check(
+        """
+        def admit(pool, n, ok):
+            pages = pool.allocate(n)
+            if not ok:
+                return None
+            pool.release(pages)
+            return n
+        """,
+        rules=["resource-leak"],
+    )
+    assert rule_names(findings) == ["resource-leak"]
+    assert "function exit" in findings[0].message
+
+
+def test_resource_leak_accepts_ownership_transfer():
+    findings = check(
+        """
+        def grab(pool, n):
+            pages = pool.allocate(n)
+            return pages
+        """,
+        rules=["resource-leak"],
+    )
+    assert findings == []
+
+
+def test_resource_leak_accepts_release_in_finally():
+    findings = check(
+        """
+        def hold(pool, n):
+            pages = pool.allocate(n)
+            try:
+                pages.append(0)
+            finally:
+                pool.release(pages)
+        """,
+        rules=["resource-leak"],
+    )
+    assert findings == []
+
+
+def test_resource_leak_accepts_failed_allocate_none_branch():
+    findings = check(
+        """
+        def admit(pool, n):
+            pages = pool.allocate(n)
+            if pages is None:
+                return None
+            pool.release(pages)
+            return n
+        """,
+        rules=["resource-leak"],
+    )
+    assert findings == []
+
+
+def test_resource_leak_flags_discarded_acquire():
+    findings = check(
+        """
+        def f(pool, n):
+            pool.allocate(n)
+        """,
+        rules=["resource-leak"],
+    )
+    assert rule_names(findings) == ["resource-leak"]
+    assert "discarded" in findings[0].message
+
+
+def test_resource_leak_flags_unexited_span():
+    findings = check(
+        """
+        def f(tracer, work):
+            s = tracer.span("step")
+            work()
+            return 1
+        """,
+        rules=["resource-leak"],
+    )
+    assert rule_names(findings) == ["resource-leak"]
+
+
+def test_resource_leak_accepts_span_context_manager():
+    findings = check(
+        """
+        def f(tracer, x):
+            with tracer.span("step"):
+                return x + 1
+        """,
+        rules=["resource-leak"],
+    )
+    assert findings == []
+
+
+def test_resource_leak_suppression_on_acquire_line():
+    findings = check(
+        """
+        def f(pool, n):
+            pages = pool.allocate(n)  # graftcheck: disable=resource-leak
+            return 1
+        """,
+        rules=["resource-leak"],
+    )
+    assert findings == []
+
+
+def test_resource_leak_reproduces_pr9_ack_credit_leak():
+    fixture = REPO_ROOT / "tests" / "fixtures" / "ack_credit_leak.py"
+    findings = engine.check_source(
+        fixture.read_text(),
+        path="tests/fixtures/ack_credit_leak.py",
+        rules=["resource-leak"],
+    )
+    assert len(findings) == 1, engine.format_human(findings)
+    (f,) = findings
+    assert "ack credit" in f.message
+    assert "batch_id" in f.message
+    assert "leaky_on_handle" in f.message  # the shipped fix stays clean
+
+
+# ---------------------------------------------------------------------------
+# wire-schema consistency
+# ---------------------------------------------------------------------------
+
+
+def test_wire_dead_field_and_strict_read():
+    findings = check(
+        """
+        def thing_to_wire(r):
+            msg = {"uid": r.uid, "n": int(r.n), "ghost": 1}
+            if r.pri != 0:
+                msg["pri"] = r.pri
+            return msg
+
+        def thing_from_wire(d):
+            return (d["uid"], d["n"], d["pri"])
+        """,
+        rules=["wire-dead-field", "wire-strict-read"],
+    )
+    names = rule_names(findings)
+    assert names.count("wire-dead-field") == 1
+    assert names.count("wire-strict-read") == 1
+    (dead,) = [f for f in findings if f.rule == "wire-dead-field"]
+    assert "'ghost'" in dead.message
+    (strict,) = [f for f in findings if f.rule == "wire-strict-read"]
+    assert "'pri'" in strict.message
+
+
+def test_wire_pair_with_fallbacks_is_clean():
+    findings = check(
+        """
+        def thing_to_wire(r):
+            msg = {"uid": r.uid}
+            if r.pri != 0:
+                msg["pri"] = r.pri
+            return msg
+
+        def thing_from_wire(d):
+            return (d["uid"], d.get("pri", 0))
+        """,
+        rules=["wire-dead-field", "wire-strict-read"],
+    )
+    assert findings == []
+
+
+def test_wire_const_mismatch():
+    findings = check(
+        """
+        import struct
+
+        FRAME_VERSION = 1
+
+        def pack_frame(b):
+            return struct.pack("<4sI", b, FRAME_VERSION)
+
+        def unpack_frame(buf):
+            return struct.unpack("<4sH", buf)
+
+        FRAME_VERSION = 2
+        """,
+        rules=["wire-const-mismatch"],
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert "FRAME_VERSION" in msgs
+    assert "<4sI" in msgs and "<4sH" in msgs
+
+
+def test_wire_const_consistent_is_clean():
+    findings = check(
+        """
+        import struct
+
+        FRAME_VERSION = 1
+
+        def pack_frame(b):
+            return struct.pack("<4sI", b, FRAME_VERSION)
+
+        def unpack_frame(buf):
+            return struct.unpack("<4sI", buf)
+        """,
+        rules=["wire-const-mismatch"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# determinism zones
+# ---------------------------------------------------------------------------
+
+
+def test_det_set_iter_flags_qos_decision():
+    findings = check(
+        """
+        def pick(queues):
+            ready = {q for q in queues if q}
+            for q in ready:
+                return q
+            return None
+        """,
+        path="progen_tpu/decode/qos.py",
+        rules=["det-set-iter"],
+    )
+    assert rule_names(findings) == ["det-set-iter"]
+
+
+def test_det_set_iter_accepts_sorted_and_out_of_zone():
+    sorted_src = """
+        def pick(queues):
+            ready = {q for q in queues if q}
+            for q in sorted(ready):
+                return q
+            return None
+        """
+    assert check(sorted_src, path="progen_tpu/decode/qos.py",
+                 rules=["det-set-iter"]) == []
+    unsorted_src = """
+        def pick(queues):
+            ready = {q for q in queues if q}
+            for q in ready:
+                return q
+            return None
+        """
+    assert check(unsorted_src, path="progen_tpu/core/ops.py",
+                 rules=["det-set-iter"]) == []
+
+
+def test_det_wallclock_zone_and_sanctioned_clock():
+    findings = check(
+        """
+        import time
+
+        def order(q):
+            return time.time()
+        """,
+        path="progen_tpu/decode/qos.py",
+        rules=["det-wallclock"],
+    )
+    assert rule_names(findings) == ["det-wallclock"]
+    # the engine scheduling zone sanctions its monotonic timebase
+    findings = check(
+        """
+        import time
+
+        def _maybe_preempt(self):
+            return time.perf_counter()
+        """,
+        path="progen_tpu/decode/engine.py",
+        rules=["det-wallclock"],
+    )
+    assert findings == []
+
+
+def test_det_ambient_rng():
+    findings = check(
+        """
+        import random
+
+        def draft(xs):
+            return xs[int(random.random() * len(xs))]
+        """,
+        path="progen_tpu/decode/spec.py",
+        rules=["det-ambient-rng"],
+    )
+    assert rule_names(findings) == ["det-ambient-rng"]
+    findings = check(
+        """
+        import random
+
+        def draft(xs, seed):
+            rng = random.Random(seed)
+            return xs[rng.randrange(len(xs))]
+        """,
+        path="progen_tpu/decode/spec.py",
+        rules=["det-ambient-rng"],
+    )
+    assert findings == []
+
+
+def test_det_hash_order_dependence():
+    findings = check(
+        """
+        def key(x):
+            return hash(x)
+        """,
+        path="progen_tpu/decode/qos.py",
+        rules=["det-ambient-rng"],
+    )
+    assert rule_names(findings) == ["det-ambient-rng"]
+    assert "PYTHONHASHSEED" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# stale suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_stale_suppression_reported_live_one_kept():
+    src = """
+        import jax.numpy as jnp
+
+        def f(q, k):
+            return jnp.einsum('id,jd->ij', q, k)  # graftcheck: disable=dtype-pet
+
+        def g(x):
+            return x  # graftcheck: disable=dtype-pet
+        """
+    findings = engine.check_source(
+        textwrap.dedent(src), path="progen_tpu/ops/x.py", report_stale=True
+    )
+    stale = [f for f in findings if f.rule == "stale-suppression"]
+    assert len(stale) == 1
+    assert stale[0].line == 8  # g's comment — f's matched a real finding
+    # report_stale off (the --allow-stale path): nothing reported
+    assert engine.check_source(
+        textwrap.dedent(src), path="progen_tpu/ops/x.py"
+    ) == []
+
+
+def test_suppression_example_in_docstring_is_inert():
+    src = '''
+        """Module docs showing the grammar:
+
+            x = risky()  # graftcheck: disable=dtype-pet
+        """
+
+        def g(x):
+            return x
+        '''
+    findings = engine.check_source(
+        textwrap.dedent(src), path="progen_tpu/ops/x.py", report_stale=True
+    )
+    assert findings == []
+
+
+def test_cli_allow_stale(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "def g(x):\n    return x  # graftcheck: disable=dtype-pet\n"
+    )
+    proc = _run_cli(str(mod), "--no-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale-suppression" in proc.stdout
+    proc = _run_cli(str(mod), "--no-baseline", "--allow-stale")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# --changed
+# ---------------------------------------------------------------------------
+
+
+def _load_cli_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graftcheck_cli", REPO_ROOT / "tools" / "graftcheck.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_changed_files_vs_ref_and_fallback(tmp_path):
+    cli = _load_cli_module()
+    # outside a git checkout: None means "fall back to a full scan"
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    assert cli.changed_files(plain, "HEAD") is None
+
+    try:
+        has_git = (
+            subprocess.run(["git", "--version"], capture_output=True)
+            .returncode
+            == 0
+        )
+    except OSError:
+        has_git = False
+    if not has_git:
+        pytest.skip("no git binary")
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=repo, capture_output=True, text=True,
+        )
+
+    assert git("init", "-q").returncode == 0
+    (repo / "a.py").write_text("A = 1\n")
+    git("add", "a.py")
+    if git("commit", "-qm", "seed").returncode != 0:
+        pytest.skip("git commit unavailable in sandbox")
+    git("branch", "-M", "main")
+    (repo / "a.py").write_text("A = 2\n")       # modified
+    (repo / "b.py").write_text("B = 1\n")       # untracked
+    (repo / "c.txt").write_text("not python\n")  # not .py: ignored
+
+    changed = cli.changed_files(repo, "HEAD")
+    assert sorted(p.name for p in changed) == ["a.py", "b.py"]
+    # bare --changed resolves the merge-base with main
+    changed = cli.changed_files(repo, cli._MERGE_BASE)
+    assert sorted(p.name for p in changed) == ["a.py", "b.py"]
+
+
+# ---------------------------------------------------------------------------
+# SARIF
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_output_schema():
+    findings = check(
+        _BARE_EINSUM.format(comment=""),
+        path="progen_tpu/ops/x.py",
+        rules=["dtype-pet"],
+    )
+    doc = json.loads(engine.format_sarif(findings, baselined=1))
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    (sarif_run,) = doc["runs"]
+    driver = sarif_run["tool"]["driver"]
+    assert driver["name"] == "graftcheck"
+    assert [r["id"] for r in driver["rules"]] == ["dtype-pet"]
+    (res,) = sarif_run["results"]
+    assert res["ruleId"] == "dtype-pet"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "progen_tpu/ops/x.py"
+    assert loc["region"]["startLine"] >= 1
+    assert loc["region"]["startColumn"] >= 1  # SARIF columns are 1-based
+    assert sarif_run["properties"]["baselined"] == 1
+
+
+def test_cli_format_sarif(tmp_path):
+    (tmp_path / "ops").mkdir()
+    bad = tmp_path / "ops" / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def f(q, k):\n"
+        "    return jnp.einsum('id,jd->ij', q, k)\n"
+    )
+    proc = _run_cli("--format", "sarif", "--no-baseline", str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"]
+
+
+def test_cli_list_rules_includes_v2_passes():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    listed = set(proc.stdout.split())
+    assert listed >= {
+        "resource-leak",
+        "wire-dead-field",
+        "wire-strict-read",
+        "wire-const-mismatch",
+        "det-set-iter",
+        "det-wallclock",
+        "det-ambient-rng",
+    }

@@ -8,7 +8,32 @@ REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO_ROOT"
 
 echo "== graftcheck =="
-python tools/graftcheck.py progen_tpu tools train.py sample.py bench.py
+python tools/graftcheck.py progen_tpu tools benchmarks \
+    train.py sample.py bench.py generate_data.py
+
+echo "== graftcheck injected-leak gate =="
+# the analyzer itself is gated the way benchdiff is: a fixture with a
+# page allocation that returns before releasing MUST exit 1, proving the
+# resource-linearity pass still bites (not just that the repo is clean)
+LEAK_DIR="$(mktemp -d)"
+cat > "$LEAK_DIR/leak.py" <<'EOF'
+def admit(pool, n, ok):
+    pages = pool.allocate(n)
+    if pages is None:
+        return None
+    if not ok:
+        return None          # injected: early return, pages never freed
+    for p in pages:
+        pool.release(p)
+    return n
+EOF
+if python tools/graftcheck.py --no-baseline --rules resource-leak \
+        "$LEAK_DIR/leak.py" > /dev/null; then
+    echo "graftcheck FAILED to flag an injected page leak" >&2
+    rm -rf "$LEAK_DIR"
+    exit 1
+fi
+rm -rf "$LEAK_DIR"
 
 echo "== compileall =="
 python -m compileall -q progen_tpu tools benchmarks tests train.py sample.py bench.py

@@ -4,13 +4,20 @@
 Usage:
 
     python tools/graftcheck.py progen_tpu tools train.py sample.py bench.py
-    python tools/graftcheck.py --json progen_tpu
+    python tools/graftcheck.py --format json progen_tpu
+    python tools/graftcheck.py --format sarif progen_tpu > findings.sarif
     python tools/graftcheck.py --rules host-sync,dtype-pet progen_tpu
+    python tools/graftcheck.py --changed            # files vs merge-base
+    python tools/graftcheck.py --changed HEAD~3 progen_tpu
     python tools/graftcheck.py --list-rules
     python tools/graftcheck.py --update-baseline progen_tpu ...
 
 Exit codes: 0 clean (or all findings baselined), 1 non-baselined findings,
 2 usage/internal error — suitable for CI.
+
+Suppression comments that never match a finding are themselves reported
+(``stale-suppression``) so sanctioned-leak comments can't rot; pass
+``--allow-stale`` to skip that check.
 
 The analyzer is pure stdlib.  ``progen_tpu/__init__`` imports jax, which
 this CLI must not pay for, so when the package is not already imported we
@@ -22,12 +29,15 @@ package ``__init__``.
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 import types
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "graftcheck_baseline.json"
+
+_MERGE_BASE = "__merge-base__"  # sentinel: bare --changed with no ref
 
 
 def _import_analysis():
@@ -42,16 +52,77 @@ def _import_analysis():
     return analysis
 
 
+def _git(args: list[str], root: Path) -> str | None:
+    try:
+        proc = subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True,
+            timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return proc.stdout
+
+
+def changed_files(root: Path, ref: str) -> list[Path] | None:
+    """Python files changed vs ``ref`` (plus untracked ones), for the
+    fast pre-commit loop.  ``None`` means "couldn't tell" — not a git
+    checkout, unknown ref, no git binary — and the caller falls back to
+    a full scan rather than silently checking nothing."""
+    if ref == _MERGE_BASE:
+        base = _git(["merge-base", "HEAD", "main"], root)
+        if base is None:
+            base = _git(["merge-base", "HEAD", "origin/main"], root)
+        if base is None:
+            return None
+        ref = base.strip()
+    diff = _git(["diff", "--name-only", "--diff-filter=d", ref], root)
+    if diff is None:
+        return None
+    untracked = _git(
+        ["ls-files", "--others", "--exclude-standard"], root) or ""
+    out: list[Path] = []
+    seen: set = set()
+    for rel in diff.splitlines() + untracked.splitlines():
+        rel = rel.strip()
+        if not rel.endswith(".py") or rel in seen:
+            continue
+        seen.add(rel)
+        p = root / rel
+        if p.is_file():
+            out.append(p)
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="graftcheck", description=__doc__.splitlines()[0]
     )
     parser.add_argument("paths", nargs="*", help="files or directories")
-    parser.add_argument("--json", action="store_true", help="JSON output")
+    parser.add_argument(
+        "--format",
+        choices=("human", "json", "sarif"),
+        default=None,
+        help="output format (default: human)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="shorthand for --format json",
+    )
     parser.add_argument(
         "--rules",
         default=None,
         help="comma-separated subset of rules to run",
+    )
+    parser.add_argument(
+        "--changed",
+        nargs="?",
+        const=_MERGE_BASE,
+        default=None,
+        metavar="REF",
+        help="lint only files changed vs REF (default: merge-base with "
+             "main); outside a git checkout this falls back to the full "
+             "scan of the given paths",
     )
     parser.add_argument(
         "--baseline",
@@ -70,9 +141,17 @@ def main(argv: list[str] | None = None) -> int:
         help="write all current findings to the baseline file and exit 0",
     )
     parser.add_argument(
+        "--allow-stale",
+        action="store_true",
+        help="don't report suppression comments that matched nothing",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
     args = parser.parse_args(argv)
+    if args.json and args.format not in (None, "json"):
+        parser.error("--json conflicts with --format " + args.format)
+    fmt = "json" if args.json else (args.format or "human")
 
     analysis = _import_analysis()
 
@@ -81,7 +160,7 @@ def main(argv: list[str] | None = None) -> int:
             print(name)
         return 0
 
-    if not args.paths:
+    if not args.paths and args.changed is None:
         parser.error("no paths given (try: progen_tpu tools train.py)")
 
     rules = args.rules.split(",") if args.rules else None
@@ -98,7 +177,32 @@ def main(argv: list[str] | None = None) -> int:
         print(f"no such path: {', '.join(map(str, missing))}", file=sys.stderr)
         return 2
 
-    findings = analysis.run(paths, root=REPO_ROOT, rules=rules)
+    if args.changed is not None:
+        changed = changed_files(REPO_ROOT, args.changed)
+        if changed is None:
+            if not paths:
+                print("--changed: not a git checkout and no paths to fall "
+                      "back to", file=sys.stderr)
+                return 2
+            print("graftcheck: --changed unavailable (no git); running a "
+                  "full scan", file=sys.stderr)
+        else:
+            if paths:
+                # intersect: only changed files under the given paths
+                roots = [p.resolve() for p in paths]
+
+                def under(f: Path) -> bool:
+                    rf = f.resolve()
+                    return any(r == rf or r in rf.parents for r in roots)
+
+                changed = [f for f in changed if under(f)]
+            paths = changed
+            if not paths:
+                print("0 finding(s) (no changed Python files)")
+                return 0
+
+    findings = analysis.run(paths, root=REPO_ROOT, rules=rules,
+                            report_stale=not args.allow_stale)
 
     if args.update_baseline:
         analysis.save_baseline(args.baseline, findings)
@@ -110,8 +214,10 @@ def main(argv: list[str] | None = None) -> int:
         baseline = analysis.load_baseline(args.baseline)
     new, baselined = analysis.apply_baseline(findings, baseline)
 
-    if args.json:
+    if fmt == "json":
         print(analysis.format_json(new, baselined=len(baselined)))
+    elif fmt == "sarif":
+        print(analysis.format_sarif(new, baselined=len(baselined)))
     else:
         print(analysis.format_human(new, baselined=len(baselined)))
     return 1 if new else 0
