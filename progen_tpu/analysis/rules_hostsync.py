@@ -131,10 +131,13 @@ HOT_ZONES: tuple[Zone, ...] = (
     Zone(r"serve/cluster\.py$",
          r"ServeCluster\.(submit|_dispatch|_shed|poll|pending|drain"
          r"|_pump|_handle_event|_on_hello|_on_handle|_on_peer_dead"
+         r"|_on_group_member_dead|_reap_member|_group_members"
+         r"|_is_group_role"
          r"|_return_credit|_check_stale|_note_clock|fleet_metrics"
          r"|_note_cache_frame|cache_stats"
          r"|_statusz_health|_statusz_status)$",
          frozenset({"router", "completions", "supervisor", "counters",
+                    "tp_group",
                     "_new", "_events", "_peers", "_procs",
                     "_handled_dead", "_respawning", "_parked_uids",
                     "_worker_stats", "_hb", "_shutting_down",
@@ -268,7 +271,10 @@ class _HostSafe:
             return True
         if isinstance(node, ast.Call):
             name = call_name(node)
-            if name == "jax.device_get":
+            # _host_fetch is the engine's group-aware device_get wrapper
+            # (decode/engine.py): same one-batched-fetch contract, plus
+            # replicated-shard handling for process-spanning arrays
+            if name in ("jax.device_get", "_host_fetch"):
                 return True
             if name and (name.startswith("np.") or name.startswith("numpy.")
                          or name.startswith("math.")):

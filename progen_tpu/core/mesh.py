@@ -80,6 +80,74 @@ class MeshConfig:
         return tuple(sizes)
 
 
+def auto_factorize(n_devices: int, *, use_fsdp: bool = True,
+                   use_tp: bool = True, use_sp: bool = True) -> MeshConfig:
+    """Factor ``n_devices`` onto ``(data, fsdp, tensor, seq)`` innermost
+    first: each enabled inner axis (seq, then tensor, then fsdp) absorbs one
+    factor of 2 while the remainder stays even; whatever is left becomes the
+    data axis.  This is the one canonical auto-factorization — the dryrun
+    entry point and the mesh benchmark both use it, so "8 devices" always
+    means the same ``(1, 2, 2, 2)`` mesh everywhere."""
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    sizes = {"seq": 1, "tensor": 1, "fsdp": 1}
+    rem = n_devices
+    for axis, enabled in (("seq", use_sp), ("tensor", use_tp),
+                          ("fsdp", use_fsdp)):
+        if enabled and rem % 2 == 0 and rem > 1:
+            sizes[axis] = 2
+            rem //= 2
+    return MeshConfig(data=rem, fsdp=sizes["fsdp"], tensor=sizes["tensor"],
+                      seq=sizes["seq"])
+
+
+def process_batch_shards(mesh: Mesh) -> tuple[int, int]:
+    """Group the processes behind ``mesh`` by the slice of the batch
+    ('data','fsdp') super-axis their devices cover, and return
+    ``(shard_count, shard_index)`` for THIS process.
+
+    This is the data-loading contract for process-spanning meshes: the
+    batch dim shards over ``('data','fsdp')`` only, so two processes whose
+    devices sit at the same (data, fsdp) coordinates — e.g. the two halves
+    of a process-spanning tensor axis — must load IDENTICAL rows, while
+    processes at different batch coordinates load disjoint shards.  With a
+    pure-dp mesh of one device per process this degenerates to
+    ``(jax.process_count(), jax.process_index())``, the pre-mesh behavior.
+
+    Raises when a process's devices straddle several distinct batch
+    coverage patterns that other processes don't share exactly — a mesh
+    layout the per-process feed (`make_array_from_process_local_data` with
+    contiguous local rows) cannot express.
+    """
+    devs = np.asarray(mesh.devices)
+    n_fsdp = devs.shape[1]
+    coverage: dict[int, set[int]] = {}
+    for idx in np.ndindex(*devs.shape):
+        batch_coord = idx[0] * n_fsdp + idx[1]
+        coverage.setdefault(devs[idx].process_index, set()).add(batch_coord)
+    me = jax.process_index()
+    if me not in coverage:
+        raise ValueError(
+            f"process {me} owns no devices in mesh {dict(mesh.shape)}"
+        )
+    # distinct coverage sets, ordered by their first batch coordinate; any
+    # overlap between distinct sets means the grouping is ambiguous
+    groups: list[frozenset[int]] = sorted(
+        {frozenset(s) for s in coverage.values()}, key=min
+    )
+    claimed: set[int] = set()
+    for g in groups:
+        if claimed & g:
+            raise ValueError(
+                "mesh layout shards the batch axis inconsistently across "
+                f"processes (coverage sets {sorted(map(sorted, groups))}); "
+                "keep each process's devices at one contiguous (data, fsdp) "
+                "block"
+            )
+        claimed |= g
+    return len(groups), groups.index(frozenset(coverage[me]))
+
+
 def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     """Build the 4-axis mesh over the given (default: all) devices.
 

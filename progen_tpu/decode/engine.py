@@ -139,6 +139,27 @@ DRAIN_TIMEOUT = "drain_timeout"
 _MAX_DEFER_STREAK = 16
 
 
+def _host_fetch(tree):
+    """Batched device→host fetch that also handles PROCESS-SPANNING
+    arrays (tp-group engines, docs/SERVING.md §13): ``jax.device_get``
+    refuses an array with non-addressable shards, but every host-read
+    engine output is replicated across the group — the local shard IS
+    the global value.  A non-replicated process-spanning leaf falls
+    back to a collective re-gather, which is safe because every group
+    member runs the same fetch at the same point in lockstep."""
+
+    def _one(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            if x.sharding.is_fully_replicated:
+                return np.asarray(x.addressable_data(0))
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(x, tiled=True)
+        return x
+
+    return jax.device_get(jax.tree_util.tree_map(_one, tree))
+
+
 class _ContainedFault(Exception):
     """Internal: a phase failed NON-transiently; the caller sheds the
     affected requests per its containment rule.  ``__cause__`` is the
@@ -1487,7 +1508,7 @@ class ServingEngine:
                  and now > self._deadline_of(r)]
         if not slots:
             return
-        active, seq, pos, start = jax.device_get(  # graftcheck: disable=host-sync
+        active, seq, pos, start = _host_fetch(
             (self.state["active"], self.state["seq"], self.state["pos"],
              self.state["start"]))
         act = self.state["active"]
@@ -2105,7 +2126,7 @@ class ServingEngine:
                 self._paused[slot] = True
             return
         self._defer_streak.pop("page_alloc", None)
-        pos = jax.device_get(  # graftcheck: disable=host-sync
+        pos = _host_fetch(
             self.state["pos"])
         for _ in range(len(self._inflight) + 1):
             slots = sorted(self._inflight, key=self._admit_order.__getitem__)
@@ -2159,13 +2180,13 @@ class ServingEngine:
         # two-phase fetch: one small transfer of the per-slot flags gates
         # the call (the common case is "nothing finished"); the big seq
         # buffer only crosses the wire when some slot actually completed
-        done, active = jax.device_get(  # graftcheck: disable=host-sync
+        done, active = _host_fetch(
             (self.state["done"], self.state["active"]))
         ready = [i for i in range(self.num_slots)
                  if done[i] and active[i] and i in self._inflight]
         if not ready:
             return []
-        seq, pos, start = jax.device_get(  # graftcheck: disable=host-sync
+        seq, pos, start = _host_fetch(
             (self.state["seq"], self.state["pos"], self.state["start"]))
         out = []
         now = time.perf_counter()
@@ -2396,7 +2417,7 @@ class ServingEngine:
         """
         entries = []
         if self._inflight:
-            active, seq, pos, start = jax.device_get(  # graftcheck: disable=host-sync
+            active, seq, pos, start = _host_fetch(
                 (self.state["active"], self.state["seq"],
                  self.state["pos"], self.state["start"]))
             for slot in sorted(self._inflight):
@@ -2660,7 +2681,7 @@ class ServingEngine:
         the dispatch-count win speculative decoding buys."""
         if not self.spec:
             return {}
-        emitted, rounds = jax.device_get(
+        emitted, rounds = _host_fetch(
             (self._spec_emitted, self._spec_verify_rounds))
         emitted, rounds = int(emitted), int(rounds)
         return {

@@ -390,6 +390,140 @@ def serialize_handle(handle: Handle, *, extra_header: dict | None = None,
     return frame
 
 
+def slab_axis(path: str, shape, group_size: int) -> int | None:
+    """The slab rule for tensor-parallel decode groups: which axis of a
+    handle-state leaf is split across the group's shards.
+
+    One pure function IS the wire contract — the cluster applies it when
+    fanning a prefill frame out into per-shard slabs, and every group
+    shard applies the inverse when reassembling its slab into a global
+    array, so sender and receivers can never disagree.  Rule: a leaf of
+    rank >= 2 whose LAST axis divides by ``group_size`` splits on that
+    axis (cache hidden dims — the tp-sharded activations); everything
+    else (token rows, per-slot scalars) replicates.  ``path`` is part of
+    the signature so a future format revision can special-case leaves
+    without changing call sites.
+    """
+    del path  # today's rule is shape-only; see docstring
+    if group_size <= 1 or len(shape) < 2:
+        return None
+    last = len(shape) - 1
+    if shape[last] >= group_size and shape[last] % group_size == 0:
+        return last
+    return None
+
+
+def split_handle_frame(header: dict, payload, group_size: int) -> list[bytes]:
+    """Fan one full handle frame out into ``group_size`` per-shard slab
+    frames for a multi-process tensor-parallel decode replica.
+
+    Pure numpy on the already-received payload bytes — no JAX, no device
+    work; this runs on the driver's relay path at forward time.  Every
+    slab frame carries the SAME group-consistent header (requests, batch
+    id, routing tags) plus a ``slab`` section naming this shard's rank,
+    the group size, and the per-leaf split axes; its manifest describes
+    the shard-local slab shapes so :func:`deserialize_handle_sharded`
+    (and plain :func:`unpack_frame`) parse it like any other frame.
+    """
+    import numpy as np
+
+    if group_size <= 1:
+        raise ValueError(f"group_size must be > 1, got {group_size}")
+    view = memoryview(payload).cast("B")
+    leaves = []
+    split: dict[str, int] = {}
+    for path, dtype, shape, off, nbytes in header["manifest"]:
+        arr = np.frombuffer(view[off:off + nbytes],
+                            dtype=_np_dtype(dtype)).reshape(shape)
+        axis = slab_axis(path, shape, group_size)
+        if axis is not None:
+            split[path] = axis
+        leaves.append((path, dtype, arr, axis))
+    base = {k: v for k, v in header.items() if k != "manifest"}
+    frames = []
+    for shard in range(group_size):
+        manifest = []
+        parts = []
+        off = 0
+        for path, dtype, arr, axis in leaves:
+            if axis is None:
+                part = arr
+            else:
+                w = arr.shape[axis] // group_size
+                sl = [slice(None)] * arr.ndim
+                sl[axis] = slice(shard * w, (shard + 1) * w)
+                part = np.ascontiguousarray(arr[tuple(sl)])
+            manifest.append([path, dtype, list(part.shape), off,
+                             part.nbytes])
+            parts.append(memoryview(
+                np.ascontiguousarray(part).reshape(-1).view(np.uint8)))
+            off += part.nbytes
+        hdr = dict(base)
+        hdr["manifest"] = manifest
+        hdr["slab"] = {"shard": shard, "group_size": group_size,
+                       "split": split}
+        frames.append(pack_frame(hdr, parts))
+    return frames
+
+
+def deserialize_handle_sharded(buf, mesh, *, header: dict | None = None,
+                               payload=None, counters=None) -> Handle:
+    """One per-shard slab frame → a :class:`Handle` of GLOBAL arrays on
+    the group's process-spanning ``mesh``.
+
+    The inverse of :func:`split_handle_frame`, run by every shard of a
+    tensor-parallel decode group on its own slab: split leaves become
+    arrays sharded over the mesh's ``tensor`` axis on their split axis
+    (shard ``k``'s slab lands at tensor coordinate ``k`` — the mesh is
+    process-ordered), replicated leaves are rebuilt whole from each
+    process's identical copy.  The group-consistent header means every
+    shard reconstructs the SAME requests and admission decision.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    t0 = time.perf_counter()
+    if header is None:
+        header, payload = unpack_frame(buf)
+    view = memoryview(payload).cast("B")
+    slab = header.get("slab") or {}
+    group_size = int(slab.get("group_size", 1))
+    split = slab.get("split") or {}
+    pairs = []
+    try:
+        for path, dtype, shape, off, nbytes in header["manifest"]:
+            local = np.ascontiguousarray(
+                np.frombuffer(view[off:off + nbytes],
+                              dtype=_np_dtype(dtype)).reshape(shape))
+            axis = split.get(path)
+            if axis is None:
+                sharding = NamedSharding(mesh, PartitionSpec())
+                gshape = tuple(shape)
+            else:
+                axis = int(axis)
+                spec = [None] * len(shape)
+                spec[axis] = "tensor"
+                sharding = NamedSharding(mesh, PartitionSpec(*spec))
+                gshape = tuple(d * group_size if i == axis else d
+                               for i, d in enumerate(shape))
+            pairs.append((path, jax.make_array_from_process_local_data(
+                sharding, local, gshape)))
+        reqs = [request_from_wire(d) for d in header["reqs"]]
+        p_pad = int(header["p_pad"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise FrameCorrupt(f"malformed slab frame header: {e}",
+                           header=header) from e
+    state = _unflatten_state(pairs)
+    h = Handle(requests=reqs, state=state, p_pad=p_pad)
+    dt = time.perf_counter() - t0
+    if counters is not None:
+        counters.de_s += dt
+    _trace.get_tracer().add("handoff.deserialize_sharded", t0, dt,
+                            uids=[r.uid for r in reqs])
+    return h
+
+
 def _np_dtype(name: str):
     import numpy as np
 

@@ -86,6 +86,45 @@ def logical_rules(strategies: Sequence[str] = ("dp",)) -> list[tuple[str, Any]]:
     return merged
 
 
+def validate_tp_divisibility(model_config, tensor_size: int,
+                             strategies: Sequence[str] = ("tp",)) -> None:
+    """Fail BEFORE jit when a tensor axis cannot divide the model dims.
+
+    The tp rule set column-shards the qkv/mlp kernels and the heads/mlp
+    activations; a tensor size that doesn't divide those dims makes GSPMD
+    fall back to padded/replicated layouts at best and abort deep inside
+    partitioning at worst — neither error names the actual mistake.  This
+    check turns it into one actionable message at Trainer/engine build
+    time.  No-op when tp isn't requested or the axis is trivial."""
+    if "tp" not in strategies or tensor_size <= 1:
+        return
+    cfg = model_config
+    dims: list[tuple[str, int]] = [
+        ("heads", cfg.heads),
+        ("attention inner dim (heads*dim_head)", cfg.heads * cfg.dim_head),
+    ]
+    seen_hidden: set[int] = set()
+    for i in range(cfg.depth):
+        gmlp = cfg.layer_uses_gmlp(i)
+        hidden = cfg.dim * cfg.ff_mult * (1 if gmlp or not cfg.ff_glu else 2)
+        if hidden not in seen_hidden:
+            seen_hidden.add(hidden)
+            dims.append((f"ff hidden dim (layer {i})", hidden))
+        if gmlp:
+            half = (cfg.dim * cfg.ff_mult) // 2
+            if half not in seen_hidden:
+                seen_hidden.add(half)
+                dims.append((f"sgu half dim (layer {i})", half))
+    bad = [(name, size) for name, size in dims if size % tensor_size]
+    if bad:
+        details = ", ".join(f"{name}={size}" for name, size in bad)
+        raise ValueError(
+            f"tensor axis size {tensor_size} does not divide the model's "
+            f"tp-sharded dims: {details}. Pick a tensor size that divides "
+            "all of them (or drop 'tp' from strategies)."
+        )
+
+
 def unbox(tree):
     """Strip flax logical-partitioning metadata boxes -> plain arrays."""
     return nn.meta.unbox(tree)

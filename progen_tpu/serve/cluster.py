@@ -40,7 +40,12 @@ from progen_tpu.decode.engine import (
     Completion,
     Request,
 )
-from progen_tpu.decode.handoff import request_to_wire
+from progen_tpu.decode.handoff import (
+    FrameCorrupt,
+    request_to_wire,
+    split_handle_frame,
+    unpack_frame,
+)
 from progen_tpu.observe import metrics as _metrics
 from progen_tpu.observe import trace as _trace
 from progen_tpu.observe.transport import TransportCounters
@@ -84,16 +89,50 @@ def _deadline_of(request) -> float | None:
     return None
 
 
+def _free_port() -> int:
+    """A free loopback port for a tp-group's private coordinator (the
+    usual bind-then-close probe; each group incarnation gets a fresh
+    one so a respawn never collides with a lingering dead job)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _split_group_frame(frame, group_size: int) -> list:
+    """Full handle frame → per-member slab frames (module-level: parses
+    and re-packs numpy payloads, so it stays OUTSIDE the cluster's
+    host-sync zone).  Validates the frame CRCs — raises
+    :class:`FrameCorrupt` on a frame that must not be forwarded."""
+    header, payload = unpack_frame(frame)
+    return split_handle_frame(header, payload, group_size)
+
+
 class ServeCluster:
-    """N prefill workers + R decode replicas behind one router."""
+    """N prefill workers + R decode replicas behind one router.
+
+    With ``tp_group=G > 1`` each decode replica is a GROUP of G member
+    processes forming one tensor-parallel engine (docs/SERVING.md §13):
+    the leader keeps the ``("decode", r)`` key, followers are
+    ``("dshard<k>", r)``.  The router still sees ONE replica per group —
+    handle frames are split into per-member slabs at relay time, and a
+    group lives and dies atomically (any member death fails the whole
+    group; respawn brings back all G members on a fresh coordinator)."""
+
+    # class-level default so bare stand-ins built around __new__ (test
+    # fixtures, controlz fakes) read as ungrouped fleets
+    tp_group = 1
 
     def __init__(self, spec: dict, *, prefill_procs: int = 1,
                  replicas: int = 1, supervisor: StageSupervisor | None = None,
                  spawn_timeout: float = 300.0, stale_after: float = 300.0,
-                 log_dir: str | None = None, route_by_cache: bool = True):
+                 log_dir: str | None = None, route_by_cache: bool = True,
+                 tp_group: int = 1):
         self.spec = spec
         self.prefill_procs = prefill_procs
         self.replicas = replicas
+        self.tp_group = max(1, int(tp_group))
         self.supervisor = supervisor or StageSupervisor(max_restarts=1)
         self.stale_after = stale_after
         self.counters = TransportCounters()  # router-side, all peers
@@ -165,11 +204,12 @@ class ServeCluster:
         for i in range(prefill_procs):
             self._worker_gen[("prefill", i)] = 0
         for i in range(replicas):
-            self._worker_gen[("decode", i)] = 0
+            for key in self._group_members(i):
+                self._worker_gen[key] = 0
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.bind(("127.0.0.1", 0))
-        self._listener.listen(prefill_procs + replicas + 4)
+        self._listener.listen(prefill_procs + replicas * self.tp_group + 4)
         self.port = self._listener.getsockname()[1]
         self._accepting = True
         self._acceptor = threading.Thread(target=self._accept_loop,
@@ -180,7 +220,10 @@ class ServeCluster:
             for i in range(prefill_procs):
                 self._spawn("prefill", i)
             for i in range(replicas):
-                self._spawn("decode", i)
+                if self.tp_group > 1:
+                    self._spawn_group(i)
+                else:
+                    self._spawn("decode", i)
             self._wait_workers(spawn_timeout)
         except Exception:
             self.shutdown(collect_stats=False)
@@ -205,7 +248,8 @@ class ServeCluster:
                                  if env.get("PYTHONPATH") else []))
         return env
 
-    def _spawn(self, role: str, idx: int) -> None:
+    def _spawn(self, role: str, idx: int,
+               group: tuple | None = None) -> None:
         # the incarnation nonce rides in every batch id the worker
         # mints: a respawn restarts batch_seq at 0, and without the
         # nonce its ids would collide with the dead incarnation's
@@ -220,14 +264,37 @@ class ServeCluster:
             (role, idx), self._spec_paths.get(gen, self._spec_path))
         log_path = self.log_dir / f"{role}_{idx}.log"
         log = open(log_path, "a")
+        env = self._worker_env()
+        if group is not None:
+            size, rank, gport = group
+            env["PROGEN_TPU_TP_GROUP_SIZE"] = str(size)
+            env["PROGEN_TPU_TP_GROUP_RANK"] = str(rank)
+            env["PROGEN_TPU_TP_GROUP_PORT"] = str(gport)
         proc = subprocess.Popen(
             [sys.executable, "-m", "progen_tpu.serve.worker",
              role, str(idx), str(self.port), str(spec_path),
              str(inc), str(gen)],
-            env=self._worker_env(), stdout=log, stderr=subprocess.STDOUT,
+            env=env, stdout=log, stderr=subprocess.STDOUT,
             cwd=str(_REPO_ROOT))
         log.close()
         self._procs[(role, idx)] = proc
+
+    def _group_members(self, idx: int) -> list:
+        """Member keys of decode replica ``idx``, leader first (a
+        one-element list when tp-grouping is off)."""
+        return [("decode", idx)] + [(f"dshard{k}", idx)
+                                    for k in range(1, self.tp_group)]
+
+    def _is_group_role(self, role) -> bool:
+        return self.tp_group > 1 and isinstance(role, str) and (
+            role == "decode" or role.startswith("dshard"))
+
+    def _spawn_group(self, idx: int) -> None:
+        """Spawn ALL member processes of tp-group replica ``idx``; the
+        group coordinator port is allocated fresh per incarnation."""
+        gport = _free_port()
+        for rank, (role, _) in enumerate(self._group_members(idx)):
+            self._spawn(role, idx, group=(self.tp_group, rank, gport))
 
     def _accept_loop(self) -> None:
         while self._accepting:
@@ -250,7 +317,7 @@ class ServeCluster:
     def _wait_workers(self, timeout: float) -> None:
         """Pump until every spawned worker said hello."""
         deadline = time.perf_counter() + timeout
-        want = self.prefill_procs + self.replicas
+        want = self.prefill_procs + self.replicas * self.tp_group
         while len(self._peers) < want:
             if time.perf_counter() > deadline:
                 raise RuntimeError(
@@ -300,7 +367,10 @@ class ServeCluster:
         idx = self._next_idx[role]
         self._next_idx[role] = idx + 1
         key = (role, idx)
-        self._worker_gen[key] = gen
+        grouped = role == "decode" and self.tp_group > 1
+        member_keys = self._group_members(idx) if grouped else [key]
+        for k in member_keys:
+            self._worker_gen[k] = gen
         if warm:
             base_path = self._spec_paths.get(gen, self._spec_path)
             warm_path = Path(self._tmp.name) / f"spec_gen{gen}_warm.json"
@@ -308,7 +378,10 @@ class ServeCluster:
                 wspec = json.loads(base_path.read_text())
                 wspec["aot_warmup"] = True
                 warm_path.write_text(json.dumps(wspec))
-            self._worker_spec[key] = warm_path
+            for k in member_keys:
+                self._worker_spec[k] = warm_path
+        # only the LEADER key gates routability: its ready frame sits
+        # behind the group barrier, so leader-ready means group-ready
         self._pending_routable.add(key)
         if role == "prefill":
             self.prefill_procs += 1
@@ -316,7 +389,10 @@ class ServeCluster:
             self.replicas += 1
         self._tracer.event("cluster.scale_up", role=role, idx=idx,
                            generation=gen)
-        self._spawn(role, idx)
+        if grouped:
+            self._spawn_group(idx)
+        else:
+            self._spawn(role, idx)
         return idx
 
     def wait_routable(self, role: str, idx: int,
@@ -398,6 +474,11 @@ class ServeCluster:
         self.supervisor.forget(role, idx)
         self._worker_spec.pop(key, None)
         self._worker_gen.pop(key, None)
+        if role == "decode" and self.tp_group > 1:
+            # followers share the leader's fate: drop their pins too
+            for k in self._group_members(idx)[1:]:
+                self._worker_spec.pop(k, None)
+                self._worker_gen.pop(k, None)
         if role == "prefill":
             self.prefill_procs -= 1
         else:
@@ -639,7 +720,19 @@ class ServeCluster:
         if (role, idx) in self._respawning:
             self._respawning.discard((role, idx))
             self._handled_dead.discard((role, idx))
-            if (role, idx) not in self._pending_routable:
+            if self._is_group_role(role):
+                # a tp-group revives as a unit, keyed by its leader:
+                # only when the LAST member's hello lands (the group
+                # engine needs every member for its collectives)
+                if (("decode", idx) not in self._pending_routable
+                        and not any(k in self._respawning
+                                    for k in self._group_members(idx))):
+                    self.router.revive_worker("decode", idx)
+                    parked, self._parked_uids = self._parked_uids, []
+                    now = time.perf_counter()
+                    for uid in parked:
+                        self._dispatch(uid, now)
+            elif (role, idx) not in self._pending_routable:
                 # a pre-ready scale-up respawn stays out of the routable
                 # set until its own ready frame (warm-before-routable)
                 self.router.revive_worker(role, idx)
@@ -773,10 +866,29 @@ class ServeCluster:
                 for uid in self.router.requeue(uids):
                     self._shed(uid, FAILED_FAULT, now)
             return
-        self.router.forward(batch_id, r, t0)
-        rp = self._peers.get(("decode", r))
-        if rp is not None and rp.alive:
-            rp.send_bytes(frame)  # verbatim relay: payload is zero-copy
+        if self.tp_group > 1:
+            # tp-group relay re-frames rather than relaying verbatim, so
+            # the driver validates the CRCs a lone replica would have —
+            # a corrupt frame takes the bad_frame path without being
+            # forwarded (the group must never see mismatched slabs)
+            try:
+                slabs = _split_group_frame(frame, self.tp_group)
+            except FrameCorrupt:
+                self._return_credit(batch_id)
+                now = time.perf_counter()
+                for uid in self.router.requeue(uids):
+                    self._dispatch(uid, now)
+                return
+            self.router.forward(batch_id, r, t0)
+            for k, member in enumerate(self._group_members(r)):
+                mp = self._peers.get(member)
+                if mp is not None and mp.alive:
+                    mp.send_bytes(slabs[k])
+        else:
+            self.router.forward(batch_id, r, t0)
+            rp = self._peers.get(("decode", r))
+            if rp is not None and rp.alive:
+                rp.send_bytes(frame)  # verbatim relay: payload zero-copy
         self._tracer.add("cluster.relay", t0, time.perf_counter() - t0,
                          uids=uids, batch_id=batch_id, replica=r)
 
@@ -799,6 +911,10 @@ class ServeCluster:
         if self._peers.get(key) is peer:
             del self._peers[key]
 
+        if self._is_group_role(peer.role):
+            self._on_group_member_dead(peer, reason)
+            return
+
         if key in self._retiring:
             # planned exit (retire/scale-down/swap): not a failure — no
             # restart budget burned, no respawn; leftovers replay
@@ -820,6 +936,65 @@ class ServeCluster:
             now = time.perf_counter()
             if (peer.role == "prefill" and self.router.prefill_alive) or \
                     (peer.role == "decode" and self.router.prefill_alive):
+                parked, self._parked_uids = self._parked_uids, []
+                for uid in parked:
+                    self._dispatch(uid, now)
+        else:
+            now = time.perf_counter()
+            for uid in affected:
+                self._dispatch(uid, now)  # sheds if the stage is gone
+
+    def _reap_member(self, key) -> None:
+        """Kill/close one tp-group member as part of its group's fate
+        (the member's own EOF event later early-returns on
+        ``_handled_dead``)."""
+        self._handled_dead.add(key)
+        proc = self._procs.get(key)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        p = self._peers.pop(key, None)
+        if p is not None:
+            p.close()
+        _metrics.get_registry().gauge(
+            _metrics.labeled("cluster.up", role=key[0],
+                             idx=key[1])).set(0.0)
+
+    def _on_group_member_dead(self, peer: Peer, reason: str) -> None:
+        """A tp-group lives and dies ATOMICALLY: one member gone means
+        the group's collectives can never complete again, so every
+        sibling is killed, the router fails the ONE replica the group
+        was, and supervision decides ONE restart for all G members (on
+        a fresh private coordinator port)."""
+        r = peer.index
+        if ("decode", r) in self._retiring:
+            # planned drain: members exit together, but their EOFs race.
+            # Followers' EOFs are noted (handled_dead) and ignored; the
+            # LEADER's EOF — last to matter, it ships the final stats —
+            # finalizes the whole group.
+            if peer.role != "decode":
+                return
+            for k in self._group_members(r)[1:]:
+                self._reap_member(k)
+            self._finalize_retire("decode", r)
+            return
+        for k in self._group_members(r):
+            if k != (peer.role, peer.index):
+                self._reap_member(k)
+                self._tracer.event("cluster.up", role=k[0], idx=k[1],
+                                   up=0, reason=f"group fate: {reason}")
+        # batches forwarded to the dead group but never admitted: their
+        # acks will never arrive, so return each credit now
+        for bid in self.router.unacked_batches(r):
+            self._return_credit(bid)
+        affected = self.router.fail_worker("decode", r)
+        if self.supervisor.request_restart("decode", r, reason):
+            for k in self._group_members(r):
+                self._respawning.add(k)
+            self._parked_uids.extend(
+                u for u in affected if u not in self._parked_uids)
+            self._spawn_group(r)
+            now = time.perf_counter()
+            if self.router.prefill_alive:
                 parked, self._parked_uids = self._parked_uids, []
                 for uid in parked:
                     self._dispatch(uid, now)
@@ -1009,6 +1184,7 @@ class ServeCluster:
         return {
             "topology": {"prefill_procs": self.prefill_procs,
                          "replicas": self.replicas,
+                         "tp_group": self.tp_group,
                          "generation": self.generation,
                          "retiring": sorted(
                              f"{r}:{i}" for r, i in self._retiring),

@@ -144,6 +144,8 @@ class ControlPlane:
             stage_seconds=stage_seconds,
             queued_by_class=c.router.queued_by_class(),
             replica_cache=c.router.cache_summary(now),
+            # tolerate pre-tp-group cluster stand-ins (test fakes)
+            tp_group=getattr(c, "tp_group", 1),
         )
 
     # ----------------------------------------------------------------- ticks
@@ -167,6 +169,7 @@ class ControlPlane:
             "queued_by_class": dict(inputs.queued_by_class),
             "replica_cache": {i: dict(v) for i, v in
                               inputs.replica_cache.items()},
+            "tp_group": inputs.tp_group,
         }
         added = []
         for d in self.policy.decide(inputs):
@@ -337,6 +340,7 @@ class ControlPlane:
             "fleet": {
                 "prefill_procs": c.prefill_procs,
                 "replicas": c.replicas,
+                "tp_group": getattr(c, "tp_group", 1),
                 "pending_routable": sorted(
                     f"{r}:{i}" for r, i in c._pending_routable),
                 "retiring": sorted(f"{r}:{i}" for r, i in c._retiring),

@@ -55,7 +55,10 @@ class PolicyInputs:
     plane's scale-down victim selection consumes it (evict the
     coldest/most-duplicated cache, never the sole holder of a hot
     prefix), and it is journaled so every scale-down is attributable
-    to the cache picture it saw."""
+    to the cache picture it saw.  ``tp_group`` is the member-process
+    count of ONE decode replica (docs/SERVING.md §13) — the policy
+    still counts replicas, but a decode decision moves ``tp_group``
+    whole processes, so cost-aware policies can weigh it."""
 
     now: float
     prefill_workers: int
@@ -67,6 +70,7 @@ class PolicyInputs:
     stage_seconds: dict = dataclasses.field(default_factory=dict)
     queued_by_class: dict = dataclasses.field(default_factory=dict)
     replica_cache: dict = dataclasses.field(default_factory=dict)
+    tp_group: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -23,11 +23,20 @@ by any worker merge into any replica and trajectories depend only on
 A payload-CRC-corrupt handle frame is reported home as a typed
 ``bad_frame`` message (the router replays the named requests); a
 desynced stream ends the process, and stage supervision restarts it.
+
+A decode replica may also be a multi-process TENSOR-PARALLEL GROUP
+(``PROGEN_TPU_TP_GROUP_*`` env vars, docs/SERVING.md §13): member 0 is
+the leader (role ``decode``), members 1..G-1 are followers (role
+``dshard<k>``, same replica index).  The group forms a private
+``jax.distributed`` job whose engine runs under a process-spanning
+``tensor=G`` mesh; every collective-bearing step is driven in lockstep
+by a leader-broadcast plan so the members' jax programs always agree.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue as _queue
 import sys
 import time
@@ -92,10 +101,19 @@ def make_spec(config, *, mixed_precision: bool = True, init_seed: int = 0,
     return spec
 
 
-def build_engine_from_spec(spec: dict, *, remote_prefill: bool = False):
+def build_engine_from_spec(spec: dict, *, remote_prefill: bool = False,
+                           group_size: int = 1):
     """Construct the ServingEngine a worker spec describes — also used
     by tests/benches to build the in-process REFERENCE engine with the
-    exact same param recipe, making token-identity a hard assert."""
+    exact same param recipe, making token-identity a hard assert.
+
+    ``group_size > 1`` builds the TP-GROUP flavor: the engine runs under
+    a process-spanning ``tensor=group_size`` mesh (one device per member
+    process) with the ``tp`` rule set, and the bit-identical per-process
+    param tree is placed as global arrays before construction.  Every
+    member calls this with the same spec, so the group's params — like a
+    single-process replica's — depend only on (init seed | checkpoint).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -132,6 +150,30 @@ def build_engine_from_spec(spec: dict, *, remote_prefill: bool = False):
             cfg, int(lcfg["tenants"]), int(lcfg["rank"]),
             seed=int(lcfg.get("seed", 0)),
             scale=float(lcfg.get("scale", 1e-2)))
+    if group_size > 1:
+        import numpy as np
+
+        from progen_tpu.core.mesh import MeshConfig, make_mesh
+        from progen_tpu.parallel.sharding import (
+            param_shardings,
+            validate_tp_divisibility,
+        )
+
+        strategies = ("tp",)
+        validate_tp_divisibility(cfg, group_size, strategies=strategies)
+        mesh = make_mesh(MeshConfig(data=1, fsdp=1, tensor=group_size,
+                                    seq=1))
+        shardings = param_shardings(model, toks, mesh, strategies)
+
+        def _place(leaf, sharding):
+            # every member holds the full leaf; hand each device its
+            # slice so placement needs no cross-process resharding
+            host = np.asarray(leaf)
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+
+        params = jax.tree_util.tree_map(_place, params, shardings)
+        kw["mesh"], kw["strategies"] = mesh, strategies
     return ServingEngine(cfg, params, policy=policy,
                          remote_prefill=remote_prefill, **kw)
 
@@ -352,6 +394,190 @@ def _decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float) -> None:
                                 max_handoff_backlog=max_backlog))
 
 
+# --- tp-group lockstep ------------------------------------------------
+#
+# A tp-group engine's jitted programs are collectives: every member must
+# issue the SAME sequence of admit/step calls or the group deadlocks.
+# The engine itself is deterministic — identical inputs in identical
+# order produce identical host state on every member — so only the
+# leader's nondeterministic inputs (which handle frames arrived, and
+# whether shutdown was requested) need broadcasting.  Each loop
+# iteration the leader publishes a tiny JSON plan; everything after it
+# is deterministic replay.
+
+_PLAN_BYTES = 16384  # fixed-size plan buffer (collectives need one shape)
+
+
+def _group_plan_exchange(plan: dict | None) -> dict:
+    """Leader→members broadcast of one lockstep plan dict.  Followers
+    pass ``None``; every member returns the leader's plan."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(_PLAN_BYTES, np.uint8)
+    if plan is not None:
+        raw = json.dumps(plan).encode()
+        if len(raw) >= _PLAN_BYTES:
+            raise ValueError(
+                f"tp-group plan overflows {_PLAN_BYTES}B: {len(raw)}B")
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    # the broadcast's internal psum promotes uint8; narrow back before
+    # reinterpreting the element buffer as the JSON byte string
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf),
+                     dtype=np.uint8)
+    return json.loads(bytes(out).rstrip(b"\x00").decode())
+
+
+def _group_all_ok(flag: bool) -> bool:
+    """Group consensus: True iff EVERY member voted True."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    votes = multihost_utils.process_allgather(
+        np.asarray([1 if flag else 0], np.int32))
+    return bool(np.asarray(votes).min() > 0)
+
+
+def _claim_slab(slabs: dict, batch_id: str, inbox, eng, peer, counters,
+                *, deadline_s: float = 120.0):
+    """Take ``batch_id``'s slab frame, waiting for late delivery.
+
+    The leader only announces batch ids it has already received, but a
+    follower's slab rides a separate TCP stream and may trail the plan
+    broadcast.  Returns ``[header, frame, recv_clock]`` or None when the
+    router died; a slab that never arrives is a wiring bug, not a
+    transient — raise rather than desync the group."""
+    deadline = time.perf_counter() + deadline_s
+    while batch_id not in slabs:
+        msgs, dead = _drain_inbox(inbox, timeout=0.2)
+        if dead:
+            return None
+        for header, frame in msgs:
+            t = header.get("type")
+            if t == "handle":
+                slabs[header.get("batch_id")] = [
+                    header, frame, time.perf_counter()]
+            elif t == "stats_req":
+                peer.send_json(_stats_frame(eng, counters))
+            # shutdown is leader-planned; a follower's copy is ignored
+        if time.perf_counter() > deadline:
+            raise RuntimeError(
+                f"tp-group slab for batch {batch_id!r} never arrived")
+    return slabs.pop(batch_id)
+
+
+def _group_decode_loop(eng, peer, inbox, counters, *, heartbeat_s: float,
+                       group_rank: int, group_size: int) -> None:
+    """Decode loop for one member of a tp-group replica.
+
+    Mirrors :func:`_decode_loop` exactly — same admit-then-step order,
+    same at-depth backpressure — but frame arrival and shutdown flow
+    through the leader's plan, deserialization verdicts take a group
+    vote (a frame only enters the engine when EVERY member could parse
+    its slab), and only the leader speaks results (ack / bad_frame /
+    completion) to the router.  Heartbeats and stats stay per-member:
+    the driver supervises each process independently."""
+    from progen_tpu.decode.handoff import (
+        FrameCorrupt,
+        deserialize_handle_sharded,
+    )
+    from progen_tpu.observe.metrics import get_registry
+    from progen_tpu.observe.trace import get_tracer
+
+    leader = group_rank == 0
+    tracer = get_tracer()
+    backlog: deque = deque()  # [header, frame, handle|None, recv_clock]
+    slabs: dict = {}          # batch_id -> [header, frame, recv_clock]
+    announce: list = []       # leader: arrived, not yet planned
+    running = True
+    max_backlog = 0
+    last_hb = time.perf_counter()
+    while running or eng.has_work or backlog:
+        idle = not (eng.has_work or backlog)
+        msgs, dead = _drain_inbox(inbox, timeout=0.1 if idle else 0.0)
+        if dead:
+            return
+        for header, frame in msgs:
+            t = header.get("type")
+            if t == "handle":
+                bid = header.get("batch_id")
+                slabs[bid] = [header, frame, time.perf_counter()]
+                if leader:
+                    announce.append(bid)
+            elif t == "shutdown":
+                if leader:
+                    running = False
+            elif t == "stats_req":
+                peer.send_json(_stats_frame(
+                    eng, counters, max_handoff_backlog=max_backlog,
+                    group_rank=group_rank, group_size=group_size))
+        plan = _group_plan_exchange(
+            {"admit": announce, "running": running} if leader else None)
+        running = bool(plan["running"])
+        announce = []
+        for bid in plan["admit"]:
+            entry = _claim_slab(slabs, bid, inbox, eng, peer, counters)
+            if entry is None:
+                return
+            backlog.append([entry[0], entry[1], None, entry[2]])
+            max_backlog = max(max_backlog, len(backlog))
+        while backlog:
+            entry = backlog[0]
+            if entry[2] is None:
+                try:
+                    handle = deserialize_handle_sharded(
+                        entry[1], eng.mesh, counters=counters)
+                    ok = True
+                except FrameCorrupt:
+                    handle, ok = None, False
+                if not _group_all_ok(ok):
+                    # some member's slab was corrupt: the whole group
+                    # drops the batch so engine states stay identical
+                    if not ok:
+                        counters.crc_failures += 1
+                    backlog.popleft()
+                    if leader:
+                        peer.send_json({
+                            "type": "bad_frame",
+                            "batch_id": entry[0].get("batch_id"),
+                            "uids": [d["uid"]
+                                     for d in entry[0].get("reqs", [])]})
+                    continue
+                entry[2] = handle
+            if not eng.admit_handle(entry[2]):
+                break  # handoff at depth: step() below frees it
+            backlog.popleft()
+            now = time.perf_counter()
+            tracer.add("worker.queue_wait", entry[3], now - entry[3],
+                       uids=[d["uid"] for d in entry[0].get("reqs", [])],
+                       batch_id=entry[0].get("batch_id"))
+            if leader:
+                peer.send_json({"type": "ack",
+                                "batch_id": entry[0].get("batch_id")})
+        if eng.has_work:
+            for c in eng.step():
+                if leader:
+                    peer.send_json(_completion_to_wire(c))
+        now = time.perf_counter()
+        if now - last_hb >= heartbeat_s:
+            last_hb = now
+            hb_msg = {
+                "type": "hb", "inflight": eng.num_active,
+                "handoff_backlog": len(backlog),
+                "clock": now,
+                "stage_seconds": eng.stage_seconds,
+                "metrics": get_registry().snapshot()}
+            if leader:
+                dig = eng.prefix_digest()
+                if dig is not None:
+                    hb_msg["digest"] = dig
+            peer.send_json(hb_msg)
+    peer.send_json(_stats_frame(eng, counters,
+                                max_handoff_backlog=max_backlog,
+                                group_rank=group_rank,
+                                group_size=group_size))
+
+
 def main(argv) -> int:
     role, index, port, spec_path = (
         argv[0], int(argv[1]), int(argv[2]), argv[3])
@@ -360,6 +586,20 @@ def main(argv) -> int:
     from progen_tpu.core.cache import enable_compilation_cache
 
     enable_compilation_cache()
+    # tp-group membership (docs/SERVING.md §13): the G member processes
+    # of one decode replica form a private jax.distributed job.  Must
+    # initialize BEFORE anything touches the backend.
+    group_size = int(os.environ.get("PROGEN_TPU_TP_GROUP_SIZE", "1"))
+    group_rank = int(os.environ.get("PROGEN_TPU_TP_GROUP_RANK", "0"))
+    if group_size > 1:
+        import jax
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            coordinator_address="localhost:{}".format(
+                int(os.environ["PROGEN_TPU_TP_GROUP_PORT"])),
+            num_processes=group_size,
+            process_id=group_rank)
     with open(spec_path) as fh:
         spec = json.load(fh)
 
@@ -421,7 +661,10 @@ def main(argv) -> int:
     print(f"worker {role}:{index} building engine", flush=True)
     holder["phase"] = "building"
     t0 = time.perf_counter()
-    eng = build_engine_from_spec(spec, remote_prefill=(role == "decode"))
+    eng = build_engine_from_spec(
+        spec,
+        remote_prefill=(role == "decode" or role.startswith("dshard")),
+        group_size=group_size)
     eng.generation = generation
     warm = {}
     if spec.get("aot_warmup"):
@@ -429,6 +672,12 @@ def main(argv) -> int:
         # scaled-up worker placeable, so every compile lands before it
         holder["phase"] = "warming"
         warm = eng.aot_warmup(max_prime=spec.get("warmup_max_prime"))
+    if group_size > 1:
+        # group barrier before ANY member reports ready: the leader's
+        # ready frame means the whole replica can run collectives
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("progen_tpu_tp_group_ready")
     print(f"worker {role}:{index} engine ready in "
           f"{time.perf_counter() - t0:.1f}s", flush=True)
     holder["eng"] = eng
@@ -447,6 +696,9 @@ def main(argv) -> int:
         _prefill_loop(eng, peer, inbox, counters,
                       heartbeat_s=hb, window=window,
                       incarnation=incarnation, generation=generation)
+    elif group_size > 1:
+        _group_decode_loop(eng, peer, inbox, counters, heartbeat_s=hb,
+                           group_rank=group_rank, group_size=group_size)
     else:
         _decode_loop(eng, peer, inbox, counters, heartbeat_s=hb)
     if tcfg and tcfg.get("dir"):

@@ -90,7 +90,20 @@ class MemoryPlan:
             ("peak = state + max(act, cast) + logits + snapshot + stage",
              self.total_bytes),
         ]
-        return "\n".join(f"  {name:<48} {b / GiB:7.2f} GiB" for name, b in rows)
+        out = "\n".join(f"  {name:<48} {b / GiB:7.2f} GiB"
+                        for name, b in rows)
+        axes = self.detail.get("axis_shards")
+        if axes:
+            # per-axis pricing: which mesh axis pays for which shard —
+            # on a process-spanning mesh this is the row that says "your
+            # weights are split fsdp x tensor WAYS, across THESE axes"
+            for kind, shards in axes.items():
+                spec = " x ".join(f"{a}={v}" for a, v in shards.items())
+                ways = 1
+                for v in shards.values():
+                    ways *= v
+                out += f"\n  {kind + ' sharded over':<48} {spec} ({ways}x)"
+        return out
 
 
 def count_params(cfg) -> int:
@@ -248,6 +261,22 @@ def plan(
         "remat": policy,
         "attn_impl": attn_impl,
         "sgu_impl": sgu_impl,
+        # per-axis shard pricing (report() renders these as plan rows):
+        # weights divide over (fsdp, tensor); batch tokens over
+        # (data, fsdp, seq); the tp-sharded activations (heads/mlp)
+        # additionally divide over tensor (_layer_saved_bytes)
+        "axis_shards": {
+            "weights": {
+                "fsdp": fsdp if "fsdp" in strategies else 1,
+                "tensor": tensor,
+            },
+            "activations": {
+                "data": data,
+                "fsdp": max(fsdp, 1),
+                "seq": seq,
+                "tensor": tensor,
+            },
+        },
     }
     # Trainer's background checkpointing keeps one extra on-device copy of
     # the full state while the save's device->host fetch runs

@@ -12,8 +12,9 @@ for TPU:
   epochs), not the reference's per-epoch ``i`` which re-checkpoints at
   ``i == 0`` of every epoch;
 * sampling uses the cached scan decoder, not O(L) full forwards;
-* multi-host aware: per-host data sharding via process_count/index, one
-  writer for checkpoints/logs.
+* multi-host aware: per-host data sharding follows the mesh's batch
+  shards (``core.mesh.process_batch_shards``) so inner mesh axes —
+  tensor/seq — may span processes, with one writer for checkpoints/logs.
 """
 
 from __future__ import annotations
@@ -29,8 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from progen_tpu.checkpoint import CheckpointStore, abstract_state_like
-from progen_tpu.parallel.sharding import batch_sharding, superbatch_sharding
-from progen_tpu.core.mesh import Mesh, MeshConfig, make_mesh
+from progen_tpu.parallel.sharding import (
+    batch_sharding, superbatch_sharding, validate_tp_divisibility,
+)
+from progen_tpu.core.mesh import (
+    Mesh, MeshConfig, make_mesh, process_batch_shards,
+)
 from progen_tpu.core.precision import make_policy
 from progen_tpu.core.rng import KeySeq
 from progen_tpu.data import decode_tokens, iterator_from_tfrecords_folder
@@ -185,6 +190,35 @@ class Trainer:
                 f"{tuple(cfg.strategies)} — the seq devices would replicate "
                 "work; add 'sp' or set MeshConfig(seq=1)"
             )
+        if (
+            self.mesh is not None
+            and self.mesh.shape.get("tensor", 1) > 1
+            and "tp" not in cfg.strategies
+        ):
+            raise ValueError(
+                "mesh has tensor axis "
+                f"{self.mesh.shape['tensor']} but 'tp' is not in strategies "
+                f"{tuple(cfg.strategies)} — the tensor devices would "
+                "replicate work; add 'tp' or set MeshConfig(tensor=1)"
+            )
+        if self.mesh is not None:
+            # a tensor size that can't divide the model dims fails GSPMD
+            # deep inside partitioning; fail here with the actual mistake
+            validate_tp_divisibility(
+                model_config, self.mesh.shape.get("tensor", 1),
+                cfg.strategies)
+        # Data-loading topology: the batch dim shards over ('data','fsdp')
+        # only, so on a process-SPANNING tensor/seq axis several processes
+        # sit at the same batch coordinates and must load IDENTICAL rows.
+        # All per-process batch math below keys off the number of distinct
+        # batch shards across processes — NOT jax.process_count(), which
+        # over-counts whenever an inner axis spans processes.
+        if self.mesh is not None and jax.process_count() > 1:
+            self.data_shard_count, self.data_shard_index = (
+                process_batch_shards(self.mesh))
+        else:
+            self.data_shard_count = jax.process_count()
+            self.data_shard_index = jax.process_index()
         # The model needs the mesh when sequence mixing must be explicit:
         # sp routes attention/SGU through the context-parallel ops, and
         # pallas attention/SGU always run full-manual inside shard_map on a
@@ -224,7 +258,7 @@ class Trainer:
         if _os.environ.get("PROGEN_SKIP_MEMORY_CHECK") != "1":
             self.memory_plan = memory_plan(
                 model_config,
-                batch_size=cfg.batch_size * jax.process_count(),
+                batch_size=cfg.batch_size * self.data_shard_count,
                 mesh_shape=dict(self.mesh.shape) if self.mesh else None,
                 strategies=cfg.strategies,
                 remat=cfg.remat,
@@ -366,23 +400,33 @@ class Trainer:
         """Host batch -> device array for the jitted step.
 
         Multi-process (one controller per host): every host holds only ITS
-        rows of the global batch; ``make_array_from_process_local_data``
+        data shard's rows of the global batch (processes sharing a batch
+        coordinate — e.g. the members of a process-spanning tensor axis —
+        hold identical copies); ``make_array_from_process_local_data``
         assembles the global sharded array without any host ever
-        materializing the full batch.  Single process: a plain transfer
-        (jit's in_shardings lay it out)."""
+        materializing the full batch.  The global shape is passed
+        explicitly: with replication across tensor-axis processes the
+        per-dimension inference would over-scale the batch dim.  Single
+        process: a plain transfer (jit's in_shardings lay it out)."""
         if self.mesh is not None and jax.process_count() > 1:
+            local = np.asarray(np_batch)
             return jax.make_array_from_process_local_data(
-                self.data_sharding, np.asarray(np_batch)
+                self.data_sharding, local,
+                (local.shape[0] * self.data_shard_count,) + local.shape[1:],
             )
         return jnp.asarray(np_batch)
 
     def _super_to_device(self, np_superbatch) -> jax.Array:
         """Host ``(K, accum, B, L)`` superbatch -> device array for the
-        fused step; multi-process, every host contributes its rows of the
-        batch dim (axis 2) — K and accum are replicated scan axes."""
+        fused step; multi-process, every host contributes its data shard's
+        rows of the batch dim (axis 2) — K and accum are replicated scan
+        axes, and tensor-axis processes contribute identical copies."""
         if self.mesh is not None and jax.process_count() > 1:
+            local = np.asarray(np_superbatch)
+            gshape = (local.shape[0], local.shape[1],
+                      local.shape[2] * self.data_shard_count, local.shape[3])
             return jax.make_array_from_process_local_data(
-                self.super_sharding, np.asarray(np_superbatch)
+                self.super_sharding, local, gshape
             )
         return jnp.asarray(np_superbatch)
 
@@ -410,12 +454,12 @@ class Trainer:
             )
 
         st = abstract(state)
-        # the REAL batch is global — cfg.batch_size rows per host assembled
-        # via make_array_from_process_local_data (_to_device) — so the warm
-        # program must match that shape+sharding or multi-host runs (the
-        # ones that compile slowest) still compile cold at step 1
+        # the REAL batch is global — cfg.batch_size rows per data shard
+        # assembled via make_array_from_process_local_data (_to_device) —
+        # so the warm program must match that shape+sharding or multi-host
+        # runs (the ones that compile slowest) still compile cold at step 1
         batch = jax.ShapeDtypeStruct(
-            (cfg.batch_size * jax.process_count(),
+            (cfg.batch_size * self.data_shard_count,
              self.model_config.seq_len + 1),
             jnp.int32,
             sharding=self.data_sharding,
@@ -455,7 +499,7 @@ class Trainer:
             def super_abstract(k):
                 return jax.ShapeDtypeStruct(
                     (k, max(1, cfg.grad_accum_every),
-                     cfg.batch_size * jax.process_count(),
+                     cfg.batch_size * self.data_shard_count,
                      self.model_config.seq_len + 1),
                     jnp.int32,
                     sharding=self.super_sharding,
@@ -587,8 +631,10 @@ class Trainer:
     def _run_attempt(self) -> dict[str, Any]:
         cfg = self.cfg
         seq_len = self.model_config.seq_len
-        process_count = jax.process_count()
-        process_index = jax.process_index()
+        # data sharding follows the mesh's batch shards, not raw process
+        # counts: tensor/seq-axis processes share a shard (identical rows)
+        shard_count = self.data_shard_count
+        shard_index = self.data_shard_index
 
         total_train, get_train = iterator_from_tfrecords_folder(
             self.data_path, "train")
@@ -610,12 +656,12 @@ class Trainer:
         epoch_position = start_seq_index % total_train
         skip = start_seq_index if cfg.shuffle_buffer else epoch_position
 
-        # global effective batch: all hosts' micro-batches x accumulation
-        effective_batch = cfg.batch_size * cfg.grad_accum_every * process_count
+        # global effective batch: all data shards' micro-batches x accum
+        effective_batch = cfg.batch_size * cfg.grad_accum_every * shard_count
 
         train_it = get_train(
             seq_len=seq_len, batch_size=cfg.batch_size, skip=skip,
-            loop=True, process_count=process_count, process_index=process_index,
+            loop=True, process_count=shard_count, process_index=shard_index,
             shuffle_buffer=cfg.shuffle_buffer, seed=cfg.seed,
         )
         stager = None
@@ -634,11 +680,11 @@ class Trainer:
             )
         valid_it = get_valid(
             seq_len=seq_len, batch_size=cfg.batch_size, loop=True,
-            process_count=process_count, process_index=process_index,
+            process_count=shard_count, process_index=shard_index,
         )
 
         num_params = sum(x.size for x in jax.tree.leaves(state.params))
-        if process_index == 0:
+        if jax.process_index() == 0:
             print(f"params: {num_params:,}")
             print(f"sequence length: {seq_len}")
             print(f"num sequences: {total_train}")
@@ -1048,18 +1094,18 @@ class Trainer:
             self.data_path, "valid")
         if total_valid == 0:
             return None
-        process_count = jax.process_count()
+        shard_count = self.data_shard_count
         it = get_valid(
             seq_len=self.model_config.seq_len, batch_size=cfg.batch_size,
-            loop=False, process_count=process_count,
-            process_index=jax.process_index(),
+            loop=False, process_count=shard_count,
+            process_index=self.data_shard_index,
         )
         # every host must run the SAME number of eval_step calls (SPMD);
-        # round-robin sharding leaves hosts with up to 1 extra record, so
-        # the count comes from the largest shard, and exhausted hosts feed
-        # all-pad batches (masked out by real_rows).
+        # round-robin sharding leaves data shards with up to 1 extra
+        # record, so the count comes from the largest shard, and exhausted
+        # shards feed all-pad batches (masked out by real_rows).
         width = self.model_config.seq_len + 1
-        max_host_records = -(-total_valid // process_count)
+        max_host_records = -(-total_valid // shard_count)
         n_batches = -(-max_host_records // cfg.batch_size)
         if max_batches is not None:
             n_batches = min(n_batches, max_batches)

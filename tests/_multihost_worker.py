@@ -1,24 +1,32 @@
-"""Subprocess worker for the multi-host smoke test.
+"""Subprocess worker for the multi-host smoke tests.
 
-Runs ONE process of a 2-process ``jax.distributed`` CPU job executing the
+Runs ONE process of an N-process ``jax.distributed`` CPU job executing the
 real Trainer.  Spawned by ``tests/test_multihost.py`` — not a test module
 itself (leading underscore keeps pytest collection away).
 
 argv: process_id num_processes port data_dir ckpt_dir runs_dir
-      [strategy [superstep [batch_size]]]
+      [strategies [superstep [batch_size [mesh_spec]]]]
 
-``strategy`` (default ``dp``): ``dp`` maps the 2-device mesh onto the
-data axis (params replicated); ``fsdp`` onto the fsdp axis (params,
-grads AND optimizer state sharded across the two processes — the
-cooperative orbax save then writes genuinely distributed arrays).
+``strategies`` (default ``dp``): ``+``-joined strategy names, e.g.
+``dp``, ``fsdp`` or ``dp+tp``.  Without an explicit ``mesh_spec`` the
+mesh maps ALL devices onto one axis: fsdp when 'fsdp' is requested,
+data otherwise (the original 2-process fixture behavior).
+
+``mesh_spec`` (``MeshConfig.parse`` format, e.g. ``2,1,2,1``): a full
+4-axis mesh over the job's global devices.  With more processes than
+axis-0 shards this builds a PROCESS-SPANNING inner axis — e.g. 4
+single-device processes under ``2,1,2,1`` put processes (0,1) at data
+shard 0 and (2,3) at data shard 1, the tensor axis pairing processes
+across the batch shards.  The Trainer's batch math follows
+``core.mesh.process_batch_shards``, so paired processes load identical
+rows.
 
 ``superstep`` (default 1): when > 1 the Trainer runs the fused
 ``train_multi_step`` loop and each process stages only its own shard of
 the (K, accum, batch, seq) superbatch.  ``log_every`` is set to the
 superstep so spans can actually fuse (``superstep_span`` never crosses a
-log boundary).  ``batch_size`` (default 2) is the PER-HOST batch: the
-test's single-process reference leg passes 4 to keep the global batch at
-4 rows either way.
+log boundary).  ``batch_size`` (default 2) is the PER-DATA-SHARD batch:
+the tests' single-process reference legs pass the full global batch.
 """
 
 import json
@@ -30,9 +38,11 @@ def main() -> None:
         int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     )
     data_dir, ckpt_dir, runs_dir = sys.argv[4], sys.argv[5], sys.argv[6]
-    strategy = sys.argv[7] if len(sys.argv) > 7 else "dp"
+    strategies = tuple((sys.argv[7] if len(sys.argv) > 7 else "dp")
+                       .split("+"))
     superstep = int(sys.argv[8]) if len(sys.argv) > 8 else 1
     batch_size = int(sys.argv[9]) if len(sys.argv) > 9 else 2
+    mesh_spec = sys.argv[10] if len(sys.argv) > 10 else None
 
     import jax
 
@@ -46,15 +56,20 @@ def main() -> None:
         process_id=process_id,
     )
     assert jax.process_count() == num_processes
-    # the mesh always spans two devices total: two processes with one
-    # device each, or one process exposing two (XLA flag set by the test)
     ndev = jax.device_count()
-    assert ndev == 2 and jax.local_device_count() == 2 // num_processes
+    assert jax.local_device_count() == ndev // num_processes
 
     from progen_tpu.core.mesh import MeshConfig
     from progen_tpu.models import ProGenConfig
     from progen_tpu.observe import Tracker
     from progen_tpu.train.trainer import Trainer, TrainerConfig
+
+    if mesh_spec is not None:
+        mesh = MeshConfig.parse(mesh_spec)
+    elif "fsdp" in strategies:
+        mesh = MeshConfig(data=1, fsdp=ndev, tensor=1, seq=1)
+    else:
+        mesh = MeshConfig(data=ndev, fsdp=1, tensor=1, seq=1)
 
     model_config = ProGenConfig(
         num_tokens=256, dim=64, seq_len=64, depth=2, window_size=32,
@@ -62,16 +77,12 @@ def main() -> None:
     )
     cfg = TrainerConfig(
         seed=7,
-        batch_size=batch_size,      # per-host -> global batch 4
+        batch_size=batch_size,      # per-data-shard micro-batch
         grad_accum_every=1,
         epochs=1,
         mixed_precision=False,      # f32 so losses compare tightly
-        strategies=(strategy,),
-        mesh=(
-            MeshConfig(data=ndev, fsdp=1, tensor=1, seq=1)
-            if strategy == "dp"
-            else MeshConfig(data=1, fsdp=ndev, tensor=1, seq=1)
-        ),
+        strategies=strategies,
+        mesh=mesh,
         superstep=superstep,
         log_every=superstep,
         validate_every=2,
@@ -92,6 +103,7 @@ def main() -> None:
 
     print(json.dumps({
         "process_id": process_id,
+        "data_shard": [trainer.data_shard_count, trainer.data_shard_index],
         "final_loss": result["loss"],
         "step": result["step"],
     }))

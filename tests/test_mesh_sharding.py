@@ -131,3 +131,96 @@ def test_xl_train_step_lowers_at_real_shapes(devices8):
         jax.ShapeDtypeStruct((batch, XL.seq_len + 1), jnp.int32),
     )
     assert lowered is not None  # tracing + SPMD lowering succeeded
+
+
+# ---------------------------------------------------------------------------
+# process-spanning mesh math (auto_factorize / process_batch_shards /
+# superbatch layout / tp divisibility) — the pure-host pieces the
+# multi-process training and tp-group serving paths both lean on.
+# ---------------------------------------------------------------------------
+
+
+def test_auto_factorize_innermost_first():
+    from progen_tpu.core.mesh import auto_factorize
+
+    assert auto_factorize(1) == MeshConfig(data=1, fsdp=1, tensor=1, seq=1)
+    # seq absorbs the first 2, tensor the second, fsdp the third
+    assert auto_factorize(4) == MeshConfig(data=1, fsdp=1, tensor=2, seq=2)
+    assert auto_factorize(8) == MeshConfig(data=1, fsdp=2, tensor=2, seq=2)
+    assert auto_factorize(16) == MeshConfig(data=2, fsdp=2, tensor=2, seq=2)
+    # odd remainders stay on the data axis
+    assert auto_factorize(6) == MeshConfig(data=3, fsdp=1, tensor=1, seq=2)
+    # disabled axes are skipped, their factor flows outward
+    assert auto_factorize(8, use_sp=False) == \
+        MeshConfig(data=2, fsdp=2, tensor=2, seq=1)
+    assert auto_factorize(8, use_sp=False, use_tp=False, use_fsdp=False) == \
+        MeshConfig(data=8, fsdp=1, tensor=1, seq=1)
+    with pytest.raises(ValueError):
+        auto_factorize(0)
+
+
+def _fake_mesh(shape, process_of):
+    """Duck-typed mesh: ``process_batch_shards`` only reads
+    ``mesh.devices`` and each device's ``process_index``."""
+    import types
+
+    devs = np.empty(shape, dtype=object)
+    for idx in np.ndindex(*shape):
+        devs[idx] = types.SimpleNamespace(process_index=process_of(idx))
+    return types.SimpleNamespace(devices=devs)
+
+
+def test_process_batch_shards_tensor_spanning_group():
+    """Two processes spanning the tensor axis cover the SAME batch rows:
+    one feed shard, both processes load identical data."""
+    from progen_tpu.core.mesh import process_batch_shards
+
+    mesh = _fake_mesh((2, 1, 2, 1), lambda idx: idx[2])
+    assert process_batch_shards(mesh) == (1, 0)
+
+
+def test_process_batch_shards_data_by_tensor_grid():
+    """A (data=2) x (tensor=2) process grid groups into 2 batch shards;
+    this process (process_index 0) sits in shard 0."""
+    from progen_tpu.core.mesh import process_batch_shards
+
+    mesh = _fake_mesh((2, 1, 2, 1), lambda idx: idx[0] * 2 + idx[2])
+    assert process_batch_shards(mesh) == (2, 0)
+
+
+def test_process_batch_shards_rejects_straddling_layout():
+    """One process spanning both data rows while others hold single rows
+    is a feed the contiguous-local-rows loader cannot express."""
+    from progen_tpu.core.mesh import process_batch_shards
+
+    mesh = _fake_mesh((2, 1, 2, 1),
+                      lambda idx: 0 if idx[2] == 0 else 1 + idx[0])
+    with pytest.raises(ValueError, match="inconsistently"):
+        process_batch_shards(mesh)
+
+
+def test_superbatch_sharding_three_axis_mesh(devices8):
+    """Superbatch (K, accum, B, L) on a (2,2,2) mesh: batch shards over
+    ('data','fsdp') only — the tensor axis replicates, so every member
+    of a tensor-spanning group sees identical superbatch rows."""
+    from progen_tpu.parallel.sharding import superbatch_sharding
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices=devices8)
+    sharding = superbatch_sharding(mesh)
+    assert sharding.spec == PartitionSpec(None, None, ("data", "fsdp"), None)
+    x = jnp.zeros((2, 2, 4, 8), jnp.float32)
+    placed = jax.device_put(x, sharding)
+    shard_shapes = {s.data.shape for s in placed.addressable_shards}
+    assert shard_shapes == {(2, 2, 1, 8)}  # B/4 per (data,fsdp) coordinate
+
+
+def test_validate_tp_divisibility_rejects_before_jit():
+    from progen_tpu.parallel.sharding import validate_tp_divisibility
+
+    # CFG: heads=2, inner=16, ff hidden=32 — 3 divides none of them
+    with pytest.raises(ValueError, match="tensor axis size 3"):
+        validate_tp_divisibility(CFG, 3, strategies=("tp",))
+    # divisible sizes and non-tp strategies pass silently
+    validate_tp_divisibility(CFG, 2, strategies=("tp",))
+    validate_tp_divisibility(CFG, 3, strategies=("fsdp",))
+    validate_tp_divisibility(CFG, 1, strategies=("tp",))

@@ -121,29 +121,33 @@ def single_proc_losses(mh_data, tmp_path_factory):
 
 
 def _run_workers(tmp_path, data_dir, strategy, *, num_processes=2,
-                 superstep=1, batch_size=2, tag="mh"):
+                 superstep=1, batch_size=2, tag="mh", total_devices=2,
+                 mesh=None, timeout=420):
     port = _free_port()
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
-        # two devices total either way: the mesh spans the two PROCESSES
-        # (one device each) or one process exposing two virtual devices
+        # total_devices devices total either way: the mesh spans the
+        # PROCESSES (total/num each) or one process exposing them all
         "XLA_FLAGS": "--xla_force_host_platform_device_count="
-                     f"{2 // num_processes}",
+                     f"{total_devices // num_processes}",
         "PYTHONPATH": str(REPO),
     }
+    argv_tail = [strategy, str(superstep), str(batch_size)]
+    if mesh is not None:
+        argv_tail.append(mesh)
     workers = [
         subprocess.Popen(
             [sys.executable, str(REPO / "tests" / "_multihost_worker.py"),
              str(i), str(num_processes), str(port), str(data_dir),
              str(tmp_path / f"ckpt_{tag}"), str(tmp_path / f"runs_{tag}"),
-             strategy, str(superstep), str(batch_size)],
+             *argv_tail],
             env=env, cwd=str(REPO),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for i in range(num_processes)
     ]
-    outs = [w.communicate(timeout=420)[0] for w in workers]
+    outs = [w.communicate(timeout=timeout)[0] for w in workers]
     for i, (w, out) in enumerate(zip(workers, outs)):
         assert w.returncode == 0, f"worker {i} failed:\n{out}"
     results = {}
@@ -258,6 +262,87 @@ def test_two_process_superstep_staging_bit_identical(
 
     # bit-identical params: restore both cooperative checkpoints in this
     # process (different topology again) and compare leaf by leaf
+    import jax
+
+    from progen_tpu.train.trainer import Trainer, TrainerConfig
+
+    cfg = TrainerConfig(seed=7, batch_size=4, grad_accum_every=1,
+                        mixed_precision=False, max_steps=3,
+                        validate_every=100, sample_every=100,
+                        checkpoint_every=100, log_every=1)
+    params = {}
+    for tag, data in (("mh", mh_data), ("sp", mh_data_interleaved)):
+        t = Trainer(model_config=MODEL_CONFIG, cfg=cfg, data_path=str(data),
+                    checkpoint_path=str(tmp_path / f"ckpt_{tag}"),
+                    use_mesh=False)
+        state, start_seq, _ = t.restore_or_init()
+        assert int(state.step) == 3 and start_seq == 12
+        params[tag] = jax.device_get(state.params)
+        t.store.close()
+    mh_leaves = jax.tree.leaves(params["mh"])
+    sp_leaves = jax.tree.leaves(params["sp"])
+    assert len(mh_leaves) == len(sp_leaves) > 0
+    for x, y in zip(mh_leaves, sp_leaves):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.slow
+def test_four_process_tensor_spanning_mesh_bit_identical(
+        tmp_path, mh_data, mh_data_interleaved):
+    """ROADMAP 1: a (data=2, tensor=2) mesh whose TENSOR axis spans
+    processes — 4 single-device workers, processes (0,1) at data shard 0
+    and (2,3) at shard 1, each tensor pair computing megatron-sharded
+    matmuls across an OS process boundary, through the unmodified fused
+    superstep loop.
+
+    Data contract under test: ``process_batch_shards`` groups the 4
+    processes into 2 batch shards, so processes 0 and 1 load IDENTICAL
+    rows (round-robin shard 0) while 2 and 3 load shard 1 — the global
+    batch assembled per step is [4k, 4k+2, 4k+1, 4k+3], exactly the
+    2-process dp union order, so the ``mh_data_interleaved`` fixture is
+    reusable as-is for the reference leg.
+
+    The reference leg is ONE process exposing 4 virtual devices with the
+    SAME (2,1,2,1) mesh and dp+tp strategies: the SPMD partitioning is
+    identical, every cross-device reduction (tp psum over 2 shards, dp
+    grad mean over 2 shards) adds the same 2 partials in the same order,
+    so the cooperative checkpoints must agree BIT-exactly — the proof
+    that spanning an inner mesh axis across processes changes nothing
+    about the math."""
+    mh = _run_workers(tmp_path, mh_data, "dp+tp", num_processes=4,
+                      total_devices=4, superstep=2, batch_size=2,
+                      mesh="2,1,2,1", timeout=600)
+    assert all(mh[i]["step"] == 3 for i in range(4))
+    # the batch-shard grouping the Trainer derived from the mesh
+    assert [mh[i]["data_shard"] for i in range(4)] == [
+        [2, 0], [2, 0], [2, 1], [2, 1]]
+    assert mh[0]["final_loss"] == pytest.approx(mh[3]["final_loss"],
+                                                rel=1e-6)
+
+    run_dirs = list((tmp_path / "runs_mh").iterdir())
+    assert [d.name for d in run_dirs] == ["multihost"]
+    metrics = [json.loads(l) for l in
+               (run_dirs[0] / "metrics.jsonl").read_text().splitlines()]
+    mh_losses = {m["step"]: m["loss"] for m in metrics if "loss" in m}
+    assert set(mh_losses) == {2}
+    assert (run_dirs[0] / "samples.html").exists()
+
+    sp = _run_workers(tmp_path, mh_data_interleaved, "dp+tp",
+                      num_processes=1, total_devices=4, superstep=2,
+                      batch_size=4, mesh="2,1,2,1", tag="sp", timeout=600)
+    assert sp[0]["step"] == 3
+    assert sp[0]["data_shard"] == [1, 0]
+    sp_metrics = [json.loads(l) for l in
+                  (tmp_path / "runs_sp" / "multihost" / "metrics.jsonl")
+                  .read_text().splitlines()]
+    sp_losses = {m["step"]: m["loss"] for m in sp_metrics if "loss" in m}
+    # identical step boundaries AND bit-identical logged loss values
+    assert sp_losses == mh_losses
+
+    # bit-identical params: restore both cooperative checkpoints in this
+    # process (different topology: no mesh at all) and compare leaf by
+    # leaf — the 4-process tensor-spanning run and the 1-process run
+    # wrote the same bits
     import jax
 
     from progen_tpu.train.trainer import Trainer, TrainerConfig

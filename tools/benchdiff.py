@@ -78,6 +78,13 @@ WATCHED: dict[str, tuple[int, float]] = {
     "token_match_rate": (+1, 0.0),
     "equal_hbm_inflight": (+1, 0.02),
     "quant_decode_tok_s": (+1, 0.30),
+    # process-spanning meshes (bench_mesh.py): checkpoint bit-parity
+    # across a process-spanning tensor/fsdp axis is deterministic by
+    # construction, so the band is zero — ANY break is a partitioning
+    # regression, not noise; the lockstep tp-group decode throughput
+    # gets the usual wall-clock band
+    "mesh_ckpt_parity": (+1, 0.0),
+    "tp_group_decode_tok_s": (+1, 0.30),
 }
 
 

@@ -253,6 +253,42 @@ JAX_PLATFORMS=cpu python benchmarks/bench_elastic.py \
 python tools/benchdiff.py --metric serving_elastic \
     "$BENCH_DIR/elastic.jsonl" "$BENCH_DIR/elastic.jsonl"
 
+echo "== mesh smoke =="
+# process-spanning meshes end to end (docs/TRAINING.md mesh topology,
+# docs/SERVING.md §13): a REAL 2-process jax.distributed training job
+# whose tensor axis spans the processes must write a cooperative
+# checkpoint bit-identical to a single-process run of the same mesh
+# (mesh_ckpt_parity), and a 2-process tensor-parallel decode group
+# behind the real cluster must be token-identical to the in-process
+# engine with zero transport CRC failures/desyncs (--smoke implies
+# --verify)
+JAX_PLATFORMS=cpu python benchmarks/bench_mesh.py \
+    --smoke --out "$BENCH_DIR/mesh.jsonl"
+# floor-gate parity against the committed full-sweep baseline: the
+# zero band on mesh_ckpt_parity means ANY bit divergence fails; the
+# wall-clock fields get throwaway bands (arbitrary CI hardware, and
+# the smoke sweep is smaller than the committed one)
+python tools/benchdiff.py benchmarks/mesh.jsonl "$BENCH_DIR/mesh.jsonl" \
+    --band wall_s=100 --band tp_group_decode_tok_s=100
+# injected parity break MUST fail the gate: a partitioning change that
+# flips even one checkpoint bit across a process boundary cannot ship
+python - "$BENCH_DIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+recs = [json.loads(ln) for ln in open(f"{d}/mesh.jsonl")]
+for rec in recs:
+    if "mesh_ckpt_parity" in rec:
+        rec["mesh_ckpt_parity"] = 0.0     # injected: ckpt bit divergence
+        rec["wall_time"] = rec.get("wall_time", 0) + 1
+open(f"{d}/mesh_bad.jsonl", "w").write(
+    "".join(json.dumps(r) + "\n" for r in recs))
+EOF
+if python tools/benchdiff.py --metric training_mesh \
+        "$BENCH_DIR/mesh.jsonl" "$BENCH_DIR/mesh_bad.jsonl"; then
+    echo "benchdiff FAILED to flag an injected mesh-parity break" >&2
+    exit 1
+fi
+
 echo "== scenario-mix smoke =="
 # all four workload classes (generate / constrained infill / embeddings /
 # multi-tenant LoRA) through ONE engine run with --verify: asserts rerun
